@@ -1,0 +1,18 @@
+"""dcr_tpu — a TPU-native (JAX/XLA/pjit/Pallas) framework with the capabilities of
+somepago/DCR: Stable-Diffusion finetuning under controlled data-duplication and
+caption-conditioning regimes, train/inference-time copying mitigations, bulk
+jit-compiled sampling, and end-to-end replication measurement (SSCD/DINO/CLIP
+similarity, FID, CLIP alignment, complexity correlations, LAION-scale search).
+
+Ground-up idiomatic JAX design — see SURVEY.md for the structural analysis of the
+reference that this framework reproduces capability-for-capability.
+
+Layering (SURVEY.md §1):
+  L0/L1  core/, parallel/   config, rng, precision, checkpoint, metrics, mesh, dist
+  L2     data/              datasets, captions, duplication, tokenizer, loader
+  L3     models/, ops/      Flax module zoo + Pallas kernels
+  L4     diffusion/, sampling/, eval/, search/   workload libraries
+  L5     cli/               thin command-line entry points
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+"""L0/L1: config, distributed init, precision, rng, checkpoint, metrics.
+
+Submodules are imported lazily by consumers (``from dcr_tpu.core import config``)
+so that config-only use never pays the jax/orbax import cost.
+"""

@@ -1,0 +1,121 @@
+"""Checkpointing: orbax-backed save/restore of params + optimizer + step + config.
+
+Fills a genuine gap in the reference: its trainer saves model weights only
+(rank-0 ``save_pretrained`` at diff_train.py:709-728) and **cannot resume** —
+no optimizer/LR/step state is ever written (SURVEY.md §5.4). Here every
+checkpoint carries the full train state, written asynchronously so the TPU never
+idles on host I/O, which is what preemptible pods need (SURVEY.md §5.3).
+
+Layout of <output_dir>:
+  config.json                  full serialized TrainConfig
+  checkpoints/<step>/          orbax composite: state (params/opt/step), ema
+A separate exporter writes the HF-style directory-of-subfolders layout
+(unet/, vae/, text_encoder/, scheduler/) for interop with the reference's
+inference convention (diff_inference.py:83-88).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+log = logging.getLogger("dcr_tpu")
+
+
+class CheckpointManager:
+    """Thin orbax CheckpointManager wrapper, async by default."""
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self._dir = Path(directory).absolute()
+        self._dir.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+
+    def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if saved:
+            log.info("checkpoint saved at step %d -> %s", step, self._dir / str(step))
+        return saved
+
+    def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self._dir}")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore(state_like))
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return list(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+# ---------------------------------------------------------------------------
+# HF-layout export/import (diffusers directory-of-subfolders convention)
+# ---------------------------------------------------------------------------
+
+def export_hf_layout(out_dir: str | Path, *, unet=None, vae=None, text_encoder=None,
+                     scheduler_config: Optional[dict] = None,
+                     model_config: Optional[dict] = None) -> None:
+    """Write checkpoint/<component>/ dirs mirroring the reference's pipeline
+    save format (diff_train.py:709-716), with params as .npz + config.json.
+    Interop is at the directory/naming level; tensors are our NHWC layout."""
+    out = Path(out_dir)
+    for name, params in (("unet", unet), ("vae", vae), ("text_encoder", text_encoder)):
+        if params is None:
+            continue
+        sub = out / name
+        sub.mkdir(parents=True, exist_ok=True)
+        flat = _flatten(params)
+        np.savez(sub / "params.npz", **flat)
+    if scheduler_config is not None:
+        sub = out / "scheduler"
+        sub.mkdir(parents=True, exist_ok=True)
+        (sub / "scheduler_config.json").write_text(json.dumps(scheduler_config, indent=2))
+    if model_config is not None:
+        (out / "model_index.json").write_text(json.dumps(model_config, indent=2))
+
+
+def import_hf_layout(ckpt_dir: str | Path, component: str) -> dict:
+    sub = Path(ckpt_dir) / component / "params.npz"
+    with np.load(sub) as z:
+        flat = {k: z[k] for k in z.files}
+    return _unflatten(flat)
+
+
+def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    else:
+        out[prefix[:-1]] = np.asarray(jax.device_get(tree))
+    return out
+
+
+def _unflatten(flat: dict[str, np.ndarray]) -> dict:
+    tree: dict = {}
+    for key, value in flat.items():
+        parts = key.split("/")
+        cur = tree
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = value
+    return tree

@@ -1,0 +1,398 @@
+"""Config system: typed dataclasses + CLI overrides + JSON round-trip.
+
+Replaces the reference's per-script argparse blobs (diff_train.py:54-280,
+diff_retrieval.py:124-182, diff_inference.py:204-219) and its
+filesystem-as-config-database pattern (diff_train.py:745-760 encodes the config
+into the output dir name; diff_inference.py:47-71 parses it back out of path
+substrings). Here every run serializes its full config to
+``<output_dir>/config.json`` so downstream stages read it directly, while
+:func:`run_name` still produces a compatible human-readable directory name.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+from dataclasses import dataclass, field, fields, is_dataclass
+from pathlib import Path
+from typing import Any, Optional, Sequence, Type, TypeVar, get_args, get_origin
+
+T = TypeVar("T")
+
+# ---------------------------------------------------------------------------
+# Enumerated capability regimes (SURVEY.md §2.1 capability checklist).
+# ---------------------------------------------------------------------------
+
+DUPLICATION_REGIMES = ("nodup", "dup_both", "dup_image")
+# Caption-conditioning regimes (reference diff_train.py:90-96; datasets.py:128-142).
+CONDITIONING_REGIMES = (
+    "nolevel",
+    "classlevel",
+    "instancelevel_blip",
+    "instancelevel_random",
+    "instancelevel_ogcap",
+)
+# Train-time caption mitigations (reference diff_train.py:257-262, datasets.py:100-125).
+TRAIN_MITIGATIONS = ("none", "allcaps", "randrepl", "randwordadd", "wordrepeat")
+# Inference-time prompt augmentations (reference diff_inference.py:14-30).
+INFERENCE_AUGS = ("none", "rand_numb_add", "rand_word_add", "rand_word_repeat")
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh shape. Axes with size 1 are still named so sharding rules are
+    uniform from 1 chip to a multi-host pod (SURVEY.md §5.8)."""
+
+    data: int = -1  # -1: all remaining devices
+    fsdp: int = 1
+    tensor: int = 1
+
+    def axis_sizes(self, n_devices: int) -> tuple[int, int, int]:
+        d, f, t = self.data, self.fsdp, self.tensor
+        known = max(1, f) * max(1, t)
+        if d == -1:
+            if n_devices % known:
+                raise ValueError(f"{n_devices} devices not divisible by fsdp*tensor={known}")
+            d = n_devices // known
+        if d * f * t != n_devices:
+            raise ValueError(f"mesh {d}x{f}x{t} != {n_devices} devices")
+        return d, f, t
+
+
+@dataclass
+class ModelConfig:
+    """Flagship diffusion-stack dimensions (SD-2.1 base by default).
+
+    The reference never defines these (it loads HF diffusers checkpoints,
+    diff_train.py:370-408); here they are explicit so tiny test/smoke variants are
+    first-class and a from-scratch UNet (reference --unet_from_scratch,
+    diff_train.py:237-243) is just a config.
+    """
+
+    # UNet2DCondition
+    sample_size: int = 32              # latent spatial size = resolution // 8
+    in_channels: int = 4
+    out_channels: int = 4
+    block_out_channels: tuple[int, ...] = (320, 640, 1280, 1280)
+    layers_per_block: int = 2
+    attention_head_dim: int = 64
+    cross_attention_dim: int = 1024
+    transformer_layers: int = 1
+    norm_num_groups: int = 32
+    flash_attention: bool = True       # Pallas kernel when on TPU, XLA fallback otherwise
+    # VAE
+    vae_block_out_channels: tuple[int, ...] = (128, 256, 512, 512)
+    vae_layers_per_block: int = 2
+    vae_latent_channels: int = 4
+    vae_scaling_factor: float = 0.18215
+    # CLIP text encoder (OpenCLIP ViT-H text tower for SD-2.1)
+    text_vocab_size: int = 49408
+    text_hidden_size: int = 1024
+    text_layers: int = 23
+    text_heads: int = 16
+    text_max_length: int = 77
+    # diffusion process
+    num_train_timesteps: int = 1000
+    beta_schedule: str = "scaled_linear"
+    beta_start: float = 0.00085
+    beta_end: float = 0.012
+    prediction_type: str = "epsilon"   # or "v_prediction"
+
+    @staticmethod
+    def tiny() -> "ModelConfig":
+        """CPU-runnable smoke config (BASELINE.json config 1)."""
+        return ModelConfig(
+            sample_size=8,
+            block_out_channels=(32, 64),
+            layers_per_block=1,
+            attention_head_dim=8,
+            cross_attention_dim=32,
+            norm_num_groups=8,
+            vae_block_out_channels=(16, 32),
+            vae_layers_per_block=1,
+            text_vocab_size=1000,
+            text_hidden_size=32,
+            text_layers=2,
+            text_heads=2,
+            text_max_length=16,
+            flash_attention=False,
+        )
+
+
+@dataclass
+class DataConfig:
+    """Dataset + duplication + conditioning knobs (reference datasets.py:32-152)."""
+
+    train_data_dir: str = ""
+    resolution: int = 256
+    center_crop: bool = True
+    random_flip: bool = True
+    class_prompt: str = "nolevel"          # CONDITIONING_REGIMES
+    instance_prompt: str = "an image"      # nolevel constant caption
+    duplication: str = "nodup"             # DUPLICATION_REGIMES
+    weight_pc: float = 0.1                 # fraction of samples duplicated
+    dup_weight: int = 5                    # sampling weight for duplicated samples
+    caption_jsons: tuple[str, ...] = ()    # blip/ogcap caption tables
+    trainspecial: str = "none"             # TRAIN_MITIGATIONS
+    trainspecial_prob: float = 0.1
+    trainsubset: int = -1                  # -1: full dataset (reference --trainsubset)
+    rand_caption_tokens: int = 4           # instancelevel_random token count
+    num_workers: int = 8
+    seed: int = 42
+
+
+@dataclass
+class OptimConfig:
+    learning_rate: float = 5e-6
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.999
+    adam_weight_decay: float = 1e-2
+    adam_epsilon: float = 1e-8
+    max_grad_norm: float = 1.0
+    lr_scheduler: str = "constant_with_warmup"
+    lr_warmup_steps: int = 5000
+    gradient_accumulation_steps: int = 1
+    scale_lr: bool = False
+
+
+@dataclass
+class TrainConfig:
+    output_dir: str = "runs/dcr"
+    pretrained_model: str = ""             # HF-layout checkpoint dir to finetune from
+    seed: int = 42
+    train_batch_size: int = 16             # per-device
+    max_train_steps: int = 100_000
+    num_train_epochs: int = 100
+    train_text_encoder: bool = False
+    unet_from_scratch: bool = False
+    mixed_precision: str = "bf16"          # "no" | "bf16"
+    ema_decay: float = 0.0                 # 0 disables EMA
+    # train-time embedding mitigations (reference diff_train.py:637-642)
+    rand_noise_lam: float = 0.0
+    mixup_noise_lam: float = 0.0
+    # cadence (reference diff_train.py:709-716; README.md:33)
+    save_steps: int = 500                  # sample-image grids
+    modelsavesteps: int = 1000             # checkpoints
+    log_every: int = 50
+    checkpoints_total_limit: int = 3
+    model: ModelConfig = field(default_factory=ModelConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+@dataclass
+class SampleConfig:
+    """Bulk sampling (reference diff_inference.py:203-243, sd_mitigation.py)."""
+
+    model_path: str = ""
+    iternum: int = -1                      # select checkpoint_<step>; -1 = final
+    savepath: str = ""
+    num_batches: int = 50
+    im_batch: int = 10                     # images per prompt per batch
+    resolution: int = 256
+    num_inference_steps: int = 50
+    guidance_scale: float = 7.5
+    sampler: str = "dpm++"                 # "ddim" | "dpm++" | "ddpm"
+    seed: int = 42
+    # inference-time mitigations
+    rand_noise_lam: float = 0.0            # gaussian noise on prompt embeddings
+    rand_augs: str = "none"                # INFERENCE_AUGS
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+@dataclass
+class EvalConfig:
+    """Replication metrics (reference diff_retrieval.py:124-182)."""
+
+    query_dir: str = ""                    # generations
+    values_dir: str = ""                   # train data
+    pt_style: str = "sscd"                 # "sscd" | "dino" | "clip"
+    arch: str = "resnet50_disc"
+    similarity_metric: str = "dotproduct"  # "dotproduct" | "splitloss"
+    batch_size: int = 64
+    image_size: int = 224
+    multiscale: bool = False
+    num_loss_chunks: int = 1
+    chunk_style: str = "max"               # splitloss chunk reduce; "cross" variant
+    compute_fid: bool = True
+    compute_clip_score: bool = True
+    compute_complexity: bool = True
+    galleries: bool = True
+    gallery_topk: int = 10
+    gallery_rows: int = 10
+    gallery_max_rank: int = 200
+    dup_weights_pickle: str = ""           # training sampling-weights file
+    output_dir: str = "ret_plots"
+    seed: int = 42
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+@dataclass
+class SearchConfig:
+    """LAION-scale embedding search (reference embedding_search/)."""
+
+    parquet_path: str = ""
+    laion_folder: str = ""
+    gen_folder: str = ""
+    embedding_out: str = "embedding.npz"
+    out_path: str = "similarity_result.npz"
+    num_chunks: int = 20
+    batch_size: int = 128
+    image_size: int = 224
+    delete_tars: bool = False
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+# ---------------------------------------------------------------------------
+# (de)serialization + CLI
+# ---------------------------------------------------------------------------
+
+
+def to_dict(cfg: Any) -> Any:
+    if is_dataclass(cfg):
+        return {f.name: to_dict(getattr(cfg, f.name)) for f in fields(cfg)}
+    if isinstance(cfg, (list, tuple)):
+        return [to_dict(v) for v in cfg]
+    return cfg
+
+
+def _coerce(value: Any, typ: Any) -> Any:
+    origin = get_origin(typ)
+    if origin in (tuple, list):
+        args = get_args(typ)
+        elem = args[0] if args else str
+        if isinstance(value, str):
+            value = [v for v in value.split(",") if v]
+        out = [_coerce(v, elem) for v in value]
+        return tuple(out) if origin is tuple else out
+    if origin is not None and str(origin) == "typing.Union":  # Optional[...]
+        args = [a for a in get_args(typ) if a is not type(None)]
+        if value is None:
+            return None
+        return _coerce(value, args[0])
+    if is_dataclass(typ):
+        return from_dict(typ, value)
+    if typ is bool:
+        if isinstance(value, str):
+            return value.lower() in ("1", "true", "yes", "y")
+        return bool(value)
+    if typ in (int, float, str):
+        return typ(value)
+    return value
+
+
+def from_dict(cls: Type[T], d: dict) -> T:
+    kwargs = {}
+    fmap = {f.name: f for f in fields(cls)}
+    for k, v in d.items():
+        if k not in fmap:
+            raise KeyError(f"unknown config key {k!r} for {cls.__name__}")
+        kwargs[k] = _coerce(v, fmap[k].type if not isinstance(fmap[k].type, str) else _resolve(cls, fmap[k].name))
+    return cls(**kwargs)
+
+
+def _resolve(cls: Type, name: str) -> Any:
+    # dataclass field types may be strings under `from __future__ import annotations`
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    return hints[name]
+
+
+def save_config(cfg: Any, path: str | Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(to_dict(cfg), indent=2, sort_keys=True) + "\n")
+
+
+def load_config(cls: Type[T], path: str | Path) -> T:
+    return from_dict(cls, json.loads(Path(path).read_text()))
+
+
+def _set_nested(d: dict, dotted: str, value: str) -> None:
+    parts = dotted.split(".")
+    cur = d
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = value
+
+
+def parse_cli(cls: Type[T], argv: Optional[Sequence[str]] = None, base: Optional[T] = None) -> T:
+    """``--a.b.c=value`` style overrides on top of defaults (or ``--config=file.json``).
+
+    Deliberately minimal: every field of the nested dataclass tree is addressable,
+    nothing else is accepted — replacing ~40 hand-kept argparse flags per script in
+    the reference.
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    overrides: dict = {}
+    cfg_path = None
+    for arg in argv:
+        if not arg.startswith("--"):
+            raise SystemExit(f"unrecognized argument {arg!r} (expected --key=value)")
+        key, eq, value = arg[2:].partition("=")
+        if key == "config":
+            cfg_path = value
+        elif not eq:
+            # bare `--flag` means true for booleans; _coerce rejects it loudly
+            # for any non-bool field (int('true') -> ValueError naming the value)
+            _set_nested(overrides, key, "true")
+        else:
+            _set_nested(overrides, key, value)
+    if base is not None and cfg_path:
+        raise SystemExit("--config cannot be combined with a programmatic base config")
+    if base is not None:
+        cfg = base
+    elif cfg_path:
+        cfg = load_config(cls, cfg_path)
+    else:
+        cfg = cls()
+    merged = to_dict(cfg)
+
+    def merge(dst: dict, src: dict) -> None:
+        for k, v in src.items():
+            if isinstance(v, dict) and isinstance(dst.get(k), dict):
+                merge(dst[k], v)
+            else:
+                dst[k] = v
+
+    merge(merged, overrides)
+    return from_dict(cls, merged)
+
+
+def run_name(cfg: TrainConfig) -> str:
+    """Human-readable run directory name, compatible in spirit with the reference's
+    output-dir mangling (diff_train.py:745-760) — but informational only: the
+    source of truth is the serialized config.json next to the checkpoint."""
+    d = cfg.data
+    parts = [d.class_prompt, d.duplication]
+    if d.duplication != "nodup":
+        parts += [str(d.weight_pc), str(d.dup_weight)]
+    if cfg.rand_noise_lam:
+        parts.append(f"glam{cfg.rand_noise_lam}")
+    if cfg.mixup_noise_lam:
+        parts.append(f"mixlam{cfg.mixup_noise_lam}")
+    if d.trainspecial != "none":
+        parts.append(f"special_{d.trainspecial}_{d.trainspecial_prob}")
+    if d.trainsubset > 0:
+        parts.append(f"{d.trainsubset}subset")
+    return "_".join(parts)
+
+
+def validate_train_config(cfg: TrainConfig) -> None:
+    """Cross-flag validation (reference diff_train.py:739-743)."""
+    d = cfg.data
+    if d.duplication not in DUPLICATION_REGIMES:
+        raise ValueError(f"duplication must be one of {DUPLICATION_REGIMES}")
+    if d.class_prompt not in CONDITIONING_REGIMES:
+        raise ValueError(f"class_prompt must be one of {CONDITIONING_REGIMES}")
+    if d.trainspecial not in TRAIN_MITIGATIONS:
+        raise ValueError(f"trainspecial must be one of {TRAIN_MITIGATIONS}")
+    if d.duplication == "dup_image" and d.class_prompt == "instancelevel_ogcap":
+        # guarded invalid in the reference (diff_train.py:739)
+        raise ValueError("dup_image requires multiple captions per image; ogcap has one")
+    if d.trainspecial != "none" and d.class_prompt != "instancelevel_blip":
+        # caption mitigations are blip-captions-only (reference diff_train.py:741-743)
+        raise ValueError("trainspecial mitigations require class_prompt=instancelevel_blip")

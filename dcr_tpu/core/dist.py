@@ -1,0 +1,73 @@
+"""Multi-host runtime initialization.
+
+One stack replaces the reference's two NCCL stacks (Accelerate DDP at
+diff_train.py:333-338 and hand-rolled torch.distributed at utils_ret.py:490-523 with
+tcp/env/SLURM rendezvous + mp.spawn): ``jax.distributed.initialize()`` joins hosts
+over DCN, XLA owns the chips, and "rank 0" becomes ``jax.process_index() == 0`` for
+I/O only. There is no per-GPU process spawn and no DataParallel fallback — a single
+Mesh covers 1..N chips uniformly (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("dcr_tpu")
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join the multi-host job if one is configured; no-op on a single host.
+
+    Env-driven (TPU pods set everything automatically; explicit args or
+    COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID cover manual CPU tests).
+    """
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if num_processes is None and "NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["NUM_PROCESSES"])
+    if process_id is None and "PROCESS_ID" in os.environ:
+        process_id = int(os.environ["PROCESS_ID"])
+    if coordinator_address or num_processes:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        log.info("joined distributed job: process %d/%d",
+                 jax.process_index(), jax.process_count())
+    _initialized = True
+
+
+def is_primary() -> bool:
+    """True on the process that owns I/O (checkpoint writes, logging, plots)."""
+    return jax.process_index() == 0
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def barrier(name: str = "barrier") -> None:
+    """Cross-host sync point (reference uses dist.barrier, diff_retrieval.py:246).
+
+    Implemented as a tiny psum over all devices — cheap, and works on any backend.
+    """
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,168 @@
+"""Metric writers: one interface, multiple sinks (jsonl, tensorboard, wandb, stdout).
+
+The reference's system of record is wandb (diff_train.py:544-553,703-705;
+diff_retrieval.py:380-383) plus MetricLogger/SmoothedValue console meters
+(utils_ret.py:526-674). Here a pluggable writer keeps the same scalar names so
+dashboards are comparable, writes process-0 only, and never makes wandb a hard
+dependency (it is absent from this environment).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from collections import defaultdict, deque
+from pathlib import Path
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+import jax
+
+log = logging.getLogger("dcr_tpu")
+
+
+class MetricWriter:
+    """Fan-out writer. No-op on non-primary processes."""
+
+    def __init__(self, logdir: str | Path, *, use_tensorboard: bool = True,
+                 use_wandb: bool = False, wandb_project: str = "dcr_tpu",
+                 run_name: Optional[str] = None, config: Optional[Mapping] = None):
+        self._active = jax.process_index() == 0
+        self._tb = None
+        self._wandb = None
+        self._jsonl = None
+        if not self._active:
+            return
+        logdir = Path(logdir)
+        logdir.mkdir(parents=True, exist_ok=True)
+        self._jsonl = (logdir / "metrics.jsonl").open("a")
+        if use_tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=str(logdir / "tb"))
+            except Exception:  # tensorboard optional
+                self._tb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project=wandb_project, name=run_name,
+                                         config=dict(config or {}), dir=str(logdir))
+            except Exception as e:
+                log.warning("wandb unavailable (%s); falling back to jsonl/tb", e)
+
+    def scalars(self, step: int, values: Mapping[str, Any]) -> None:
+        if not self._active:
+            return
+        clean = {}
+        for k, v in values.items():
+            v = np.asarray(v)
+            clean[k] = float(v) if v.ndim == 0 else v.tolist()
+        rec = {"step": int(step), "time": time.time(), **clean}
+        self._jsonl.write(json.dumps(rec) + "\n")
+        self._jsonl.flush()
+        if self._tb:
+            for k, v in clean.items():
+                if isinstance(v, float):
+                    self._tb.add_scalar(k, v, step)
+        if self._wandb:
+            self._wandb.log(clean, step=step)
+
+    def image(self, step: int, name: str, image: np.ndarray) -> None:
+        """image: HWC uint8."""
+        if not self._active:
+            return
+        if self._tb is not None:
+            self._tb.add_image(name, image, step, dataformats="HWC")
+        if self._wandb is not None:
+            import wandb
+
+            self._wandb.log({name: wandb.Image(image)}, step=step)
+
+    def close(self) -> None:
+        if not self._active:
+            return
+        self._jsonl.close()
+        if self._tb:
+            self._tb.close()
+        if self._wandb:
+            self._wandb.finish()
+
+
+class SmoothedValue:
+    """Windowed/global average meter (reference utils_ret.py:526-570). The
+    cross-process synchronize uses a psum on the mesh instead of dist.all_reduce."""
+
+    def __init__(self, window_size: int = 20):
+        self.deque: deque = deque(maxlen=window_size)
+        self.total = 0.0
+        self.count = 0
+
+    def update(self, value: float, n: int = 1) -> None:
+        self.deque.append(value)
+        self.count += n
+        self.total += value * n
+
+    @property
+    def median(self) -> float:
+        return float(np.median(self.deque)) if self.deque else 0.0
+
+    @property
+    def avg(self) -> float:
+        return float(np.mean(self.deque)) if self.deque else 0.0
+
+    @property
+    def global_avg(self) -> float:
+        return self.total / max(self.count, 1)
+
+    def synchronize_between_processes(self) -> None:
+        if jax.process_count() == 1:
+            return
+        from jax.experimental import multihost_utils
+
+        t = multihost_utils.process_allgather(np.array([self.count, self.total]))
+        t = np.sum(t, axis=0)
+        self.count, self.total = int(t[0]), float(t[1])
+
+
+class MetricLogger:
+    """Console iteration logger with ETA + data/iter timing
+    (reference utils_ret.py:573-674, minus the CUDA memory counter)."""
+
+    def __init__(self, delimiter: str = "  "):
+        self.meters: dict[str, SmoothedValue] = defaultdict(SmoothedValue)
+        self.delimiter = delimiter
+
+    def update(self, **kwargs: float) -> None:
+        for k, v in kwargs.items():
+            self.meters[k].update(float(v))
+
+    def __str__(self) -> str:
+        return self.delimiter.join(f"{k}: {m.avg:.4f}" for k, m in self.meters.items())
+
+    def synchronize_between_processes(self) -> None:
+        for m in self.meters.values():
+            m.synchronize_between_processes()
+
+    def log_every(self, iterable, print_freq: int, header: str = ""):
+        start = time.time()
+        iter_time = SmoothedValue()
+        data_time = SmoothedValue()
+        end = time.time()
+        n = len(iterable) if hasattr(iterable, "__len__") else None
+        for i, obj in enumerate(iterable):
+            data_time.update(time.time() - end)
+            yield obj
+            iter_time.update(time.time() - end)
+            if i % print_freq == 0 and jax.process_index() == 0:
+                eta = ""
+                if n:
+                    eta = f" eta: {int(iter_time.global_avg * (n - i))}s"
+                log.info("%s [%d%s]%s %s iter: %.4fs data: %.4fs", header, i,
+                         f"/{n}" if n else "", eta, self, iter_time.avg, data_time.avg)
+            end = time.time()
+        if jax.process_index() == 0:
+            log.info("%s done in %.1fs", header, time.time() - start)

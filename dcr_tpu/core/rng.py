@@ -1,0 +1,42 @@
+"""Explicit, reproducible RNG streams.
+
+The reference leans on global numpy/torch RNG (diff_train.py:637-642,
+datasets.py:102-125), which breaks determinism under reordering. Here every
+consumer derives its keys from (root seed, stream name, step), so any step of any
+stream is recomputable in isolation — required for preemption-safe resume and for
+mitigations inside jit (SURVEY.md §7.3).
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def _stream_tag(name: str) -> int:
+    # stable 31-bit tag from the stream name
+    return int.from_bytes(hashlib.sha256(name.encode()).digest()[:4], "little") & 0x7FFFFFFF
+
+
+def stream_key(root: jax.Array, name: str) -> jax.Array:
+    """Named substream (e.g. 'noise', 'timesteps', 'mixup', 'sample')."""
+    return jax.random.fold_in(root, _stream_tag(name))
+
+
+def step_key(stream: jax.Array, step: jax.Array | int) -> jax.Array:
+    """Per-step key — jit-safe (step may be a traced int32)."""
+    return jax.random.fold_in(stream, jnp.asarray(step, jnp.uint32))
+
+
+def host_python_rng(seed: int, name: str):
+    """Deterministic host-side numpy Generator for data-pipeline decisions
+    (caption picks, augmentation choices) that must stay out of jit."""
+    import numpy as np
+
+    return np.random.Generator(np.random.PCG64([seed, _stream_tag(name)]))

@@ -1,0 +1,1 @@
+"""L3: Flax module zoo + diffusion schedule math."""

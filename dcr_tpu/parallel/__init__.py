@@ -1,0 +1,14 @@
+from dcr_tpu.parallel import mesh  # noqa: F401
+from dcr_tpu.parallel.mesh import (  # noqa: F401
+    AXES,
+    DATA_AXIS,
+    FSDP_AXIS,
+    TENSOR_AXIS,
+    batch_sharding,
+    data_parallel_size,
+    fsdp_sharding_for_params,
+    make_mesh,
+    replicated,
+    shard_batch,
+    use_mesh,
+)

@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU platform so every
+mesh/pjit/collective test runs without TPU hardware (SURVEY.md §4 item 3)."""
+
+import os
+
+# jax is pre-imported at interpreter startup in this environment (so env vars are
+# too late for platform selection) — use jax.config, which takes effect as long as
+# no backend has been initialized yet.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {devs}"
+    return devs
+
+
+@pytest.fixture()
+def rng_np():
+    return np.random.default_rng(0)

@@ -1,0 +1,99 @@
+import json
+
+import pytest
+
+from dcr_tpu.core import config as C
+
+
+def test_roundtrip(tmp_path):
+    cfg = C.TrainConfig()
+    cfg.data.class_prompt = "instancelevel_blip"
+    cfg.model.block_out_channels = (32, 64)
+    p = tmp_path / "config.json"
+    C.save_config(cfg, p)
+    loaded = C.load_config(C.TrainConfig, p)
+    assert loaded == cfg
+    assert isinstance(loaded.model.block_out_channels, tuple)
+
+
+def test_cli_overrides():
+    cfg = C.parse_cli(
+        C.TrainConfig,
+        [
+            "--train_batch_size=4",
+            "--data.duplication=dup_both",
+            "--data.weight_pc=0.25",
+            "--model.block_out_channels=32,64",
+            "--optim.learning_rate=1e-5",
+            "--train_text_encoder=true",
+        ],
+    )
+    assert cfg.train_batch_size == 4
+    assert cfg.data.duplication == "dup_both"
+    assert cfg.data.weight_pc == 0.25
+    assert cfg.model.block_out_channels == (32, 64)
+    assert cfg.optim.learning_rate == 1e-5
+    assert cfg.train_text_encoder is True
+
+
+def test_cli_unknown_key_rejected():
+    with pytest.raises(KeyError):
+        C.parse_cli(C.TrainConfig, ["--nonsense=1"])
+
+
+def test_config_from_file(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text(json.dumps({"seed": 7, "data": {"resolution": 64}}))
+    cfg = C.parse_cli(C.TrainConfig, [f"--config={p}", "--seed=9"])
+    assert cfg.seed == 9
+    assert cfg.data.resolution == 64
+
+
+def test_run_name_encodes_regimes():
+    cfg = C.TrainConfig()
+    cfg.data.class_prompt = "instancelevel_blip"
+    cfg.data.duplication = "dup_both"
+    cfg.data.weight_pc = 0.2
+    cfg.data.dup_weight = 10
+    cfg.mixup_noise_lam = 0.5
+    name = C.run_name(cfg)
+    assert "instancelevel_blip" in name and "dup_both" in name
+    assert "0.2" in name and "10" in name and "mixlam0.5" in name
+
+
+def test_validation_rules():
+    cfg = C.TrainConfig()
+    cfg.data.duplication = "dup_image"
+    cfg.data.class_prompt = "instancelevel_ogcap"
+    with pytest.raises(ValueError):
+        C.validate_train_config(cfg)
+    cfg2 = C.TrainConfig()
+    cfg2.data.trainspecial = "allcaps"
+    cfg2.data.class_prompt = "nolevel"
+    with pytest.raises(ValueError):
+        C.validate_train_config(cfg2)
+    cfg3 = C.TrainConfig()
+    cfg3.data.trainspecial = "allcaps"
+    cfg3.data.class_prompt = "instancelevel_blip"
+    C.validate_train_config(cfg3)  # ok
+
+
+def test_mesh_axis_sizes():
+    m = C.MeshConfig(data=-1, fsdp=2, tensor=1)
+    assert m.axis_sizes(8) == (4, 2, 1)
+    with pytest.raises(ValueError):
+        C.MeshConfig(data=3, fsdp=2, tensor=1).axis_sizes(8)
+
+
+def test_cli_bare_bool_flag():
+    cfg = C.parse_cli(C.TrainConfig, ["--train_text_encoder"])
+    assert cfg.train_text_encoder is True
+    with pytest.raises(ValueError):
+        C.parse_cli(C.TrainConfig, ["--train_batch_size"])
+
+
+def test_cli_config_plus_base_rejected(tmp_path):
+    p = tmp_path / "c.json"
+    p.write_text("{}")
+    with pytest.raises(SystemExit):
+        C.parse_cli(C.TrainConfig, [f"--config={p}"], base=C.TrainConfig())

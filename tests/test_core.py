@@ -1,0 +1,96 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcr_tpu.core import precision, rng
+from dcr_tpu.core.checkpoint import CheckpointManager, export_hf_layout, import_hf_layout
+from dcr_tpu.core.config import MeshConfig
+from dcr_tpu.parallel import mesh as pmesh
+
+
+def test_mesh_creation(cpu_devices):
+    m = pmesh.make_mesh(MeshConfig(data=-1, fsdp=2))
+    assert m.shape["data"] == 4 and m.shape["fsdp"] == 2 and m.shape["tensor"] == 1
+
+
+def test_shard_batch_and_psum(cpu_devices):
+    m = pmesh.make_mesh(MeshConfig())
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(16, 1)}
+    sharded = pmesh.shard_batch(m, batch)
+    assert sharded["x"].sharding.spec == jax.sharding.PartitionSpec(("data", "fsdp"))
+    # global mean through jit matches numpy
+    out = jax.jit(lambda b: jnp.mean(b["x"]))(sharded)
+    assert np.isclose(float(out), np.mean(batch["x"]))
+
+
+def test_fsdp_param_sharding(cpu_devices):
+    m = pmesh.make_mesh(MeshConfig(data=-1, fsdp=4))
+    params = {
+        "big": jnp.zeros((1024, 256)),
+        "small": jnp.zeros((3,)),
+        "odd": jnp.zeros((1025, 3)),  # not divisible by 4 on any big-enough axis
+    }
+    shardings = pmesh.fsdp_sharding_for_params(m, params)
+    assert shardings["big"].spec[0] == "fsdp"
+    assert shardings["small"].spec == jax.sharding.PartitionSpec()
+    assert shardings["odd"].spec == jax.sharding.PartitionSpec()
+
+
+def test_precision_policy():
+    pol = precision.policy_from_string("bf16")
+    tree = {"w": jnp.ones((2, 2), jnp.float32), "ids": jnp.ones((2,), jnp.int32)}
+    ct = pol.cast_to_compute(tree)
+    assert ct["w"].dtype == jnp.bfloat16
+    assert ct["ids"].dtype == jnp.int32
+    back = pol.cast_to_param(ct)
+    assert back["w"].dtype == jnp.float32
+    with pytest.raises(ValueError):
+        precision.policy_from_string("fp16")
+
+
+def test_rng_streams_deterministic_and_distinct():
+    root = rng.root_key(42)
+    a1 = jax.random.normal(rng.step_key(rng.stream_key(root, "noise"), 3), (4,))
+    a2 = jax.random.normal(rng.step_key(rng.stream_key(root, "noise"), 3), (4,))
+    b = jax.random.normal(rng.step_key(rng.stream_key(root, "timesteps"), 3), (4,))
+    c = jax.random.normal(rng.step_key(rng.stream_key(root, "noise"), 4), (4,))
+    np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+    assert not np.allclose(np.asarray(a1), np.asarray(b))
+    assert not np.allclose(np.asarray(a1), np.asarray(c))
+
+
+def test_host_rng_streams():
+    g1 = rng.host_python_rng(1, "captions")
+    g2 = rng.host_python_rng(1, "captions")
+    g3 = rng.host_python_rng(1, "augs")
+    s1, s2, s3 = g1.integers(0, 1 << 30, 5), g2.integers(0, 1 << 30, 5), g3.integers(0, 1 << 30, 5)
+    np.testing.assert_array_equal(s1, s2)
+    assert not np.array_equal(s1, s3)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    state = {
+        "params": {"w": jnp.arange(6, dtype=jnp.float32).reshape(2, 3)},
+        "step": jnp.asarray(5),
+    }
+    mgr = CheckpointManager(tmp_path / "ckpt", async_save=False)
+    assert mgr.save(5, state)
+    mgr.wait()
+    like = jax.tree.map(lambda x: jnp.zeros_like(x), state)
+    restored = mgr.restore(like)
+    np.testing.assert_array_equal(np.asarray(restored["params"]["w"]),
+                                  np.asarray(state["params"]["w"]))
+    assert int(restored["step"]) == 5
+    assert mgr.latest_step() == 5
+    mgr.close()
+
+
+def test_hf_layout_roundtrip(tmp_path):
+    unet = {"conv_in": {"kernel": np.ones((3, 3, 4, 8), np.float32)},
+            "time_mlp": {"bias": np.zeros(8, np.float32)}}
+    export_hf_layout(tmp_path / "checkpoint", unet=unet,
+                     scheduler_config={"num_train_timesteps": 1000})
+    back = import_hf_layout(tmp_path / "checkpoint", "unet")
+    np.testing.assert_array_equal(back["conv_in"]["kernel"], unet["conv_in"]["kernel"])
+    assert (tmp_path / "checkpoint" / "scheduler" / "scheduler_config.json").exists()
