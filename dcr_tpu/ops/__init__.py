@@ -1,0 +1,3 @@
+"""Device kernels: attention dispatch (XLA or Pallas flash) and Pallas kernels."""
+
+from dcr_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,131 @@
+"""Pallas TPU flash attention (forward streaming-softmax kernel).
+
+Replaces the role xformers' CUDA memory-efficient attention plays in the
+reference (diff_train.py:578): O(S) memory attention for the UNet's spatial
+self-attention at 512px+ (S=4096 latent tokens). Classic FlashAttention
+online-softmax over key blocks; logits/statistics accumulate in f32 on the MXU
+regardless of the bf16 compute dtype.
+
+Backward: custom_vjp recomputes attention with the XLA path (same math — exact
+gradients, no stored S×S matrix in the fwd). A fused Pallas bwd kernel is a
+later optimization; the fwd kernel is what bounds sampling/inference memory.
+
+Layout contract: [B, S, H, D] at the dispatcher, reshaped to [B*H, S, D] here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU memory spaces are unavailable when only CPU jaxlib is present
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+BLOCK_Q = 256
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
+    """Kernel-friendly shapes: blocks divide sequence lengths, D fits the MXU lane
+    layout. Anything else falls back to XLA attention (correct, still fused)."""
+    if q.ndim != 4:
+        return False
+    _, sq, _, d = q.shape
+    sk = k.shape[1]
+    return (
+        sq % BLOCK_Q == 0
+        and sk % BLOCK_K == 0
+        and d in (64, 128, 256)
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    sk = k_ref.shape[1]
+    bq, d = q.shape
+
+    def body(kb, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [bq, bk]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, acc0))
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+
+def _flash_fwd(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
+               interpret: bool) -> jax.Array:
+    """q3/k3/v3: [BH, S, D]."""
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=BLOCK_K)
+    mem = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, sq // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
+        ],
+        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+        interpret=interpret,
+    )(q3, k3, v3)
+
+
+def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+    """XLA attention on [B, S, H, D]; used for the recompute backward."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    weights = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                    interpret: bool = False) -> jax.Array:
+    """Flash attention over [B, S, H, D] tensors. interpret=True runs the same
+    kernel through the Pallas interpreter (CPU tests)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    to3 = lambda x, s: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    o3 = _flash_fwd(to3(q, sq), to3(k, sk), to3(v, sk), interpret=interpret)
+    return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _fwd_rule(q, k, v, interpret):
+    return flash_attention(q, k, v, interpret), (q, k, v)
+
+
+def _bwd_rule(interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(_reference_attention, q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,75 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcr_tpu.ops import attention as A
+from dcr_tpu.ops import flash_attention as FA
+
+
+def _rand_qkv(key, b=2, sq=512, sk=256, h=2, d=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, sq, h, d), dtype)
+    k = jax.random.normal(kk, (b, sk, h, d), dtype)
+    v = jax.random.normal(kv, (b, sk, h, d), dtype)
+    return q, k, v
+
+
+def test_supported_shapes():
+    q, k, v = _rand_qkv(jax.random.key(0))
+    assert FA.supported(q, k, v)
+    q2, k2, v2 = _rand_qkv(jax.random.key(0), sq=100)
+    assert not FA.supported(q2, k2, v2)
+    q3, k3, v3 = _rand_qkv(jax.random.key(0), sk=77)
+    assert not FA.supported(q3, k3, v3)  # CLIP cross-attn length falls back to XLA
+    q4, k4, v4 = _rand_qkv(jax.random.key(0), d=48)
+    assert not FA.supported(q4, k4, v4)
+
+
+def test_flash_matches_xla_forward():
+    q, k, v = _rand_qkv(jax.random.key(1))
+    ref = A.dot_product_attention(q, k, v, use_flash=False)
+    out = FA.flash_attention(q, k, v, True)  # interpret mode on CPU
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_matches_xla_self_attention_4096():
+    """The 512px UNet shape the kernel exists for (S=4096)."""
+    q, k, v = _rand_qkv(jax.random.key(2), b=1, sq=1024, sk=1024, h=1, d=64)
+    ref = A.dot_product_attention(q, k, v, use_flash=False)
+    out = FA.flash_attention(q, k, v, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_bf16_close_to_f32():
+    q, k, v = _rand_qkv(jax.random.key(3), dtype=jnp.bfloat16)
+    ref = A.dot_product_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                                  v.astype(jnp.float32), use_flash=False)
+    out = FA.flash_attention(q, k, v, True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32), np.asarray(ref),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_flash_gradients_match_xla():
+    q, k, v = _rand_qkv(jax.random.key(4), b=1, sq=256, sk=128, h=1, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(FA.flash_attention(q, k, v, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.dot_product_attention(q, k, v, use_flash=False) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4, rtol=1e-4)
+
+
+def test_softmax_stability_large_logits():
+    """Online softmax must survive logits that would overflow naive exp."""
+    q, k, v = _rand_qkv(jax.random.key(5), b=1, sq=256, sk=128, h=1, d=64)
+    q = q * 100.0
+    out = FA.flash_attention(q, k, v, True)
+    assert np.all(np.isfinite(np.asarray(out)))
+    ref = A.dot_product_attention(q, k, v, use_flash=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
