@@ -42,6 +42,8 @@ class CheckpointManager:
         self._mgr = ocp.CheckpointManager(self._dir, options=options)
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if step in self._mgr.all_steps():
+            return False  # idempotent: final save may coincide with a periodic one
         saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self._dir / str(step))

@@ -167,6 +167,7 @@ class TrainConfig:
     train_text_encoder: bool = False
     unet_from_scratch: bool = False
     mixed_precision: str = "bf16"          # "no" | "bf16"
+    remat: bool = False                    # jax.checkpoint the UNet fwd (512px+)
     ema_decay: float = 0.0                 # 0 disables EMA
     # train-time embedding mitigations (reference diff_train.py:637-642)
     rand_noise_lam: float = 0.0

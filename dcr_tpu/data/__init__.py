@@ -1,0 +1,1 @@
+"""L2: datasets, captions, duplication weights, tokenization, host data loading."""

@@ -1,0 +1,133 @@
+"""Host data loader: deterministic sampling plan + threaded prefetch.
+
+Replaces the reference's torch DataLoader + WeightedRandomSampler stack
+(diff_train.py:470-487) with a TPU-host-friendly design:
+
+- a *sampling plan* is computed up front per (seed, epoch): weighted-with-
+  replacement under dup regimes, shuffled otherwise — so every process knows
+  the full global order and takes its own slice (no sampler state to sync);
+- worker threads decode/augment (PIL releases the GIL for the heavy parts) into
+  a bounded queue; batches are contiguous numpy, ready for shard_batch;
+- iteration order is fully reproducible given (seed, epoch), including across
+  restarts mid-epoch via `start_step`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from dcr_tpu.data import duplication as D
+from dcr_tpu.data.dataset import ObjectAttributeDataset
+
+
+class Batch(dict):
+    """dict with attribute access: pixel_values [B,H,W,3], input_ids [B,L],
+    index [B]."""
+
+    __getattr__ = dict.__getitem__
+
+
+def sampling_plan(dataset: ObjectAttributeDataset, *, epoch: int,
+                  seed: int) -> np.ndarray:
+    """Global epoch order. Under dup_both/dup_image: weighted WITH replacement
+    (the duplication mechanism itself — reference diff_train.py:470-479);
+    otherwise a plain shuffle."""
+    n = len(dataset)
+    if dataset.cfg.duplication in ("dup_both", "dup_image"):
+        weights = np.asarray(dataset.sampling_weights)[dataset.active_indices]
+        return D.weighted_sample_indices(weights, n, seed, epoch)
+    return D.shuffled_indices(n, seed, epoch)
+
+
+class DataLoader:
+    def __init__(self, dataset: ObjectAttributeDataset, *, batch_size: int,
+                 num_workers: int = 8, seed: int = 0,
+                 process_index: int = 0, process_count: int = 1,
+                 drop_last: bool = True, prefetch: int = 4):
+        if batch_size % 1:
+            raise ValueError("batch_size must be int")
+        self.dataset = dataset
+        self.global_batch_size = batch_size * process_count
+        self.batch_size = batch_size
+        self.num_workers = max(1, num_workers)
+        self.seed = seed
+        self.process_index = process_index
+        self.process_count = process_count
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+        if len(dataset) < self.global_batch_size and drop_last:
+            raise ValueError(
+                f"dataset of {len(dataset)} samples can't fill one global batch "
+                f"of {self.global_batch_size}")
+
+    def steps_per_epoch(self) -> int:
+        return len(self.dataset) // self.global_batch_size
+
+    def epoch(self, epoch: int, start_step: int = 0) -> Iterator[Batch]:
+        """Yield this process's local batches for one epoch."""
+        plan = sampling_plan(self.dataset, epoch=epoch, seed=self.seed)
+        steps = self.steps_per_epoch()
+        out_q: "queue.Queue[tuple[int, Optional[Batch], Optional[BaseException]]]" = (
+            queue.Queue(maxsize=self.prefetch))
+        stop = threading.Event()
+
+        def make_batch(step: int) -> Batch:
+            base = step * self.global_batch_size + self.process_index * self.batch_size
+            positions = plan[base: base + self.batch_size]
+            examples = [self.dataset.get(int(p), epoch=epoch, slot=base + j)
+                        for j, p in enumerate(positions)]
+            return Batch(
+                pixel_values=np.stack([e.pixel_values for e in examples]),
+                input_ids=np.stack([e.input_ids for e in examples]),
+                index=np.asarray([e.index for e in examples], np.int64),
+            )
+
+        def safe_put(item) -> bool:
+            # never block forever: re-check stop so consumer-side teardown can't
+            # leave producers pinned in put() holding decoded batches
+            while not stop.is_set():
+                try:
+                    out_q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker(worker_id: int) -> None:
+            for step in range(start_step + worker_id, steps, self.num_workers):
+                if stop.is_set():
+                    return
+                try:
+                    if not safe_put((step, make_batch(step), None)):
+                        return
+                except BaseException as e:  # surface decode errors to the consumer
+                    safe_put((step, None, e))
+                    return
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        pending: dict[int, Batch] = {}
+        try:
+            for step in range(start_step, steps):
+                while step not in pending:
+                    got_step, batch, err = out_q.get()
+                    if err is not None:
+                        raise err
+                    pending[got_step] = batch
+                yield pending.pop(step)
+        finally:
+            stop.set()
+            # drain until every worker has exited (safe_put re-checks stop, so
+            # this terminates promptly)
+            for t in threads:
+                while t.is_alive():
+                    try:
+                        out_q.get_nowait()
+                    except queue.Empty:
+                        t.join(timeout=0.05)

@@ -1,0 +1,1 @@
+"""L4a: diffusion finetuning — pjit train step, Trainer loop, mitigation hooks."""

@@ -1,0 +1,300 @@
+import json
+import pickle
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcr_tpu.core.config import DataConfig
+from dcr_tpu.data import captions as C
+from dcr_tpu.data import duplication as D
+from dcr_tpu.data.dataset import ObjectAttributeDataset, list_image_folder
+from dcr_tpu.data.loader import DataLoader
+from dcr_tpu.data.tokenizer import HashTokenizer, load_tokenizer
+
+
+@pytest.fixture()
+def image_folder(tmp_path):
+    rng = np.random.default_rng(0)
+    paths = {}
+    for cls in ["c0", "c1"]:
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.integers(0, 255, (40, 52, 3), np.uint8)
+            p = d / f"{cls}_{i}.png"
+            Image.fromarray(arr).save(p)
+            paths[str(p)] = [f"a {cls} photo number {i}", f"alt caption {i} for {cls}",
+                             f"third caption {i}"]
+    capfile = tmp_path / "caps.json"
+    capfile.write_text(json.dumps(paths))
+    return tmp_path / "data", capfile
+
+
+def _cfg(root, capfile=None, **kw):
+    d = dict(train_data_dir=str(root), resolution=32, num_workers=2, seed=7)
+    if capfile:
+        d["caption_jsons"] = (str(capfile),)
+    d.update(kw)
+    return DataConfig(**d)
+
+
+def test_list_image_folder_deterministic(image_folder):
+    root, _ = image_folder
+    paths, labels, classes = list_image_folder(root)
+    assert classes == ["c0", "c1"]
+    assert len(paths) == 12
+    assert labels == sorted(labels)
+    assert paths == sorted(paths)
+
+
+def test_dataset_nolevel(image_folder):
+    root, _ = image_folder
+    ds = ObjectAttributeDataset(_cfg(root, class_prompt="nolevel",
+                                     instance_prompt="An image"), HashTokenizer(100, 16))
+    ex = ds.get(0)
+    assert ex.pixel_values.shape == (32, 32, 3)
+    assert ex.pixel_values.min() >= -1.0 and ex.pixel_values.max() <= 1.0
+    assert ex.caption == "An image"
+    assert ex.input_ids.shape == (16,)
+
+
+def test_dataset_classlevel(image_folder):
+    root, _ = image_folder
+    ds = ObjectAttributeDataset(_cfg(root, class_prompt="classlevel"), HashTokenizer(100, 16))
+    assert ds.get(0).caption == "An image of c0"
+    assert ds.get(len(ds) - 1).caption == "An image of c1"
+
+
+def test_dataset_instancelevel_blip_first_caption(image_folder):
+    root, caps = image_folder
+    ds = ObjectAttributeDataset(
+        _cfg(root, caps, class_prompt="instancelevel_blip"), HashTokenizer(100, 16))
+    ex = ds.get(0)
+    assert ex.caption.startswith("a c0 photo number")
+
+
+def test_instancelevel_requires_captions(image_folder):
+    root, _ = image_folder
+    with pytest.raises(ValueError):
+        ObjectAttributeDataset(_cfg(root, class_prompt="instancelevel_blip"),
+                               HashTokenizer(100, 16))
+
+
+def test_dup_image_randomizes_caption_only_for_duplicated(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip", duplication="dup_image",
+               weight_pc=0.5, dup_weight=10)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    dup_idx = [i for i in range(len(ds)) if ds.sampling_weights[i] > 1]
+    nondup_idx = [i for i in range(len(ds)) if ds.sampling_weights[i] == 1]
+    assert dup_idx and nondup_idx
+    # non-duplicated: always first caption, any epoch
+    for i in nondup_idx[:3]:
+        for e in range(3):
+            assert ds.get(i, epoch=e).caption == ds.prompts[ds.paths[i]][0]
+    # duplicated: caption varies across epochs (3 captions available)
+    seen = {ds.get(dup_idx[0], epoch=e).caption for e in range(12)}
+    assert len(seen) > 1
+
+
+def test_weights_cache_roundtrip_and_reference_format(image_folder, tmp_path):
+    root, _ = image_folder
+    w1 = D.load_or_create_weights(root, 12, 0.25, 5, 42)
+    w2 = D.load_or_create_weights(root, 12, 0.25, 5, 42)
+    np.testing.assert_array_equal(w1, w2)
+    assert (w1 == 5).sum() == 3 and (w1 == 1).sum() == 9
+    # file is a plain pickled list of ints, like the reference writes
+    path = D.weights_cache_path(root, 0.25, 5, 42)
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    assert isinstance(raw, list) and sorted(set(raw)) == [1, 5]
+    with pytest.raises(ValueError):
+        D.load_or_create_weights(root, 13, 0.25, 5, 42)  # stale cache detected
+
+
+def test_trainsubset(image_folder):
+    root, _ = image_folder
+    ds = ObjectAttributeDataset(_cfg(root, class_prompt="nolevel", trainsubset=4),
+                                HashTokenizer(100, 16))
+    assert len(ds) == 4
+
+
+def test_mitigation_allcaps_samples_all(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip", trainspecial="allcaps")
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    seen = {ds.get(0, epoch=e).caption for e in range(20)}
+    assert len(seen) == 3  # all three captions get sampled
+
+
+def test_mitigation_randwordadd_inserts_two_words(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip",
+               trainspecial="randwordadd", trainspecial_prob=1.0)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    base = ds.prompts[ds.paths[0]][0]
+    cap = ds.get(0).caption
+    assert len(cap.split()) == len(base.split()) + 2
+
+
+def test_mitigation_wordrepeat_uses_existing_words(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip",
+               trainspecial="wordrepeat", trainspecial_prob=1.0)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    base_words = set(ds.prompts[ds.paths[0]][0].split())
+    cap = ds.get(0).caption
+    assert set(cap.split()) == base_words  # only repeats, no new words
+    assert len(cap.split()) == len(ds.prompts[ds.paths[0]][0].split()) + 2
+
+
+def test_mitigation_randrepl_prob_zero_keeps_caption(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip",
+               trainspecial="randrepl", trainspecial_prob=0.0)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    assert ds.get(0).caption == ds.prompts[ds.paths[0]][0]
+
+
+def test_instancelevel_random_decodes_token_lists(image_folder):
+    root, _ = image_folder
+    tok = HashTokenizer(100, 16)
+    paths, _, _ = list_image_folder(root)
+    caps = {p: [str([int(i) for i in np.random.default_rng(7).integers(1, 90, 4)])]
+            for p in paths}
+    cfg = _cfg(root, class_prompt="instancelevel_random")
+    ds = ObjectAttributeDataset(cfg, tok, caption_tables=caps)
+    cap = ds.get(0).caption
+    assert isinstance(cap, str) and len(cap.split()) == 4
+
+
+def test_determinism_across_instances(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip", random_flip=True,
+               center_crop=False)
+    ds1 = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    ds2 = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    e1, e2 = ds1.get(3, epoch=5), ds2.get(3, epoch=5)
+    np.testing.assert_array_equal(e1.pixel_values, e2.pixel_values)
+    np.testing.assert_array_equal(e1.input_ids, e2.input_ids)
+    # different epoch -> different crop
+    e3 = ds1.get(3, epoch=6)
+    assert not np.array_equal(e1.pixel_values, e3.pixel_values)
+
+
+def test_loader_batches_and_sharding(image_folder):
+    root, _ = image_folder
+    ds = ObjectAttributeDataset(_cfg(root, class_prompt="nolevel"), HashTokenizer(100, 16))
+    # two "processes" each batch_size=2: global order must partition
+    loaders = [DataLoader(ds, batch_size=2, num_workers=2, seed=1,
+                          process_index=p, process_count=2) for p in range(2)]
+    assert loaders[0].steps_per_epoch() == 3
+    all_indices = []
+    batches0 = list(loaders[0].epoch(0))
+    batches1 = list(loaders[1].epoch(0))
+    assert len(batches0) == 3
+    for b0, b1 in zip(batches0, batches1):
+        assert b0.pixel_values.shape == (2, 32, 32, 3)
+        all_indices.extend(b0.index.tolist())
+        all_indices.extend(b1.index.tolist())
+    assert len(all_indices) == 12 and len(set(all_indices)) == 12  # exact partition
+    # reproducible
+    again = list(loaders[0].epoch(0))
+    np.testing.assert_array_equal(batches0[0].pixel_values, again[0].pixel_values)
+    # resume mid-epoch
+    resumed = list(loaders[0].epoch(0, start_step=2))
+    np.testing.assert_array_equal(resumed[0].index, batches0[2].index)
+
+
+def test_loader_weighted_replacement_oversamples(image_folder):
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip", duplication="dup_both",
+               weight_pc=0.25, dup_weight=50)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    loader = DataLoader(ds, batch_size=4, num_workers=2, seed=3)
+    counts = np.zeros(12)
+    for e in range(30):
+        for b in loader.epoch(e):
+            for i in b.index:
+                counts[i] += 1
+    dup = np.asarray(ds.sampling_weights) > 1
+    assert counts[dup].mean() > 5 * counts[~dup].mean()
+
+
+def test_tokenizer_fallback_and_padding():
+    tok = load_tokenizer(None, vocab_size=1000, model_max_length=16)
+    ids = tok(["hello world", "a much longer caption with many more words than fit in the window easily truncated"])
+    assert ids.shape == (2, 16)
+    assert ids.dtype == np.int32
+    assert ids[0, 0] == tok.bos_token_id
+    assert tok.eos_token_id in ids[0]
+    # deterministic
+    ids2 = tok("hello world")
+    np.testing.assert_array_equal(ids[0], ids2[0])
+    # decode inverts for hash tokenizer
+    assert tok.decode(tok.encode("hello world")) == "hello world"
+
+
+def test_clip_bpe_tokenizer_roundtrip(tmp_path):
+    from dcr_tpu.data.tokenizer import ClipBPETokenizer, _bytes_to_unicode
+
+    # minimal vocab: all byte tokens, word-final variants, one merge
+    b2u = _bytes_to_unicode()
+    vocab = {}
+    for ch in b2u.values():
+        vocab[ch] = len(vocab)
+        vocab[ch + "</w>"] = len(vocab)
+    vocab["he"] = len(vocab)
+    vocab["llo</w>"] = len(vocab)
+    vocab["hello</w>"] = len(vocab)
+    vocab["<|startoftext|>"] = len(vocab)
+    vocab["<|endoftext|>"] = len(vocab)
+    (tmp_path / "vocab.json").write_text(json.dumps(vocab))
+    (tmp_path / "merges.txt").write_text("#version: 0.2\nh e\nl l\nll o</w>\nhe llo</w>\n")
+    tok = ClipBPETokenizer(tmp_path / "vocab.json", tmp_path / "merges.txt",
+                           model_max_length=8)
+    ids = tok.encode("hello")
+    assert ids == [vocab["hello</w>"]]
+    assert tok.decode(ids) == "hello"
+    batch = tok("hello hello")
+    assert batch.shape == (1, 8)
+    assert batch[0, 0] == tok.bos_token_id
+    # unknown-ish text still tokenizes via byte fallback
+    assert tok.decode(tok.encode("hexo")) == "hexo"
+    # loader picks BPE when files exist
+    from dcr_tpu.data.tokenizer import load_tokenizer
+    got = load_tokenizer(tmp_path)
+    assert isinstance(got, ClipBPETokenizer)
+
+
+def test_dup_image_caption_varies_per_occurrence_within_epoch(image_folder):
+    """Regression: the same duplicated image drawn at different plan slots in ONE
+    epoch must redraw its caption (the reference redraws per __getitem__)."""
+    root, caps = image_folder
+    cfg = _cfg(root, caps, class_prompt="instancelevel_blip", duplication="dup_image",
+               weight_pc=0.5, dup_weight=10)
+    ds = ObjectAttributeDataset(cfg, HashTokenizer(100, 16))
+    dup_pos = next(i for i in range(len(ds)) if ds.sampling_weights[i] > 1)
+    seen_caps = {ds.get(dup_pos, epoch=0, slot=s).caption for s in range(12)}
+    assert len(seen_caps) > 1
+    seen_px = {ds.get(dup_pos, epoch=0, slot=s).pixel_values.tobytes()
+               for s in range(6)}
+    assert len(seen_px) > 1  # crops redraw per occurrence too (random crop on)
+
+
+def test_loader_no_leaked_worker_threads(image_folder):
+    """Regression: breaking out of an epoch mid-iteration must not leave worker
+    threads blocked in queue.put."""
+    import threading
+    import time
+
+    root, _ = image_folder
+    ds = ObjectAttributeDataset(_cfg(root, class_prompt="nolevel"), HashTokenizer(100, 16))
+    before = threading.active_count()
+    loader = DataLoader(ds, batch_size=1, num_workers=6, seed=1, prefetch=2)
+    it = loader.epoch(0)
+    next(it)
+    it.close()  # triggers the generator's finally
+    time.sleep(0.3)
+    assert threading.active_count() <= before + 1

@@ -1,0 +1,228 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcr_tpu.core.config import MeshConfig, ModelConfig, TrainConfig
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.diffusion import train as T
+from dcr_tpu.diffusion.trainer import build_models
+from dcr_tpu.parallel import mesh as pmesh
+
+
+def _cfg(**kw):
+    cfg = TrainConfig(**kw)
+    cfg.model = ModelConfig.tiny()
+    cfg.mixed_precision = "no"
+    cfg.optim.learning_rate = 1e-3
+    cfg.optim.lr_scheduler = "constant"
+    cfg.optim.lr_warmup_steps = 0
+    return cfg
+
+
+def _batch(key, cfg, bsz=8):
+    px = 8 * 2 ** (len(cfg.model.vae_block_out_channels) - 1)
+    return {
+        "pixel_values": jax.random.uniform(key, (bsz, px, px, 3)) * 2 - 1,
+        "input_ids": jax.random.randint(jax.random.fold_in(key, 1),
+                                        (bsz, cfg.model.text_max_length), 0,
+                                        cfg.model.text_vocab_size),
+        "index": jnp.arange(bsz),
+    }
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = _cfg()
+    models, params = build_models(cfg, jax.random.key(0))
+    return cfg, models, params
+
+
+def _make_state(cfg, models, params, mesh):
+    # the train step donates its input state; copy so the shared fixture params
+    # survive across tests
+    params = jax.tree.map(lambda x: jnp.array(np.asarray(x)), params)
+    state = T.init_train_state(cfg, models, unet_params=params["unet"],
+                               text_params=params["text"], vae_params=params["vae"])
+    return T.shard_train_state(state, mesh)
+
+
+def test_train_step_runs_and_loss_decreases(setup, cpu_devices):
+    cfg, models, params = setup
+    mesh = pmesh.make_mesh(MeshConfig())
+    state = _make_state(cfg, models, params, mesh)
+    step_fn = T.make_train_step(cfg, models, mesh)
+    key = rngmod.root_key(0)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg)))
+    losses = []
+    for _ in range(30):
+        state, metrics = step_fn(state, batch, key)
+        losses.append(float(metrics["loss"]))
+    assert int(jax.device_get(state.step)) == 30
+    assert np.isfinite(losses).all()
+    # same batch repeatedly -> loss must drop substantially
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) * 0.9, losses
+
+
+def test_train_step_deterministic(setup, cpu_devices):
+    cfg, models, params = setup
+    mesh = pmesh.make_mesh(MeshConfig())
+    step_fn = T.make_train_step(cfg, models, mesh)
+    key = rngmod.root_key(0)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg)))
+    s1 = _make_state(cfg, models, params, mesh)
+    s1, m1 = step_fn(s1, batch, key)
+    s2 = _make_state(cfg, models, params, mesh)
+    s2, m2 = step_fn(s2, batch, key)
+    assert float(m1["loss"]) == float(m2["loss"])
+    leaves1, leaves2 = jax.tree.leaves(s1.unet_params), jax.tree.leaves(s2.unet_params)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fsdp_mesh_train_step(setup, cpu_devices):
+    """Same step under data=4 x fsdp=2 sharding must match pure-DP numerics."""
+    cfg, models, params = setup
+    key = rngmod.root_key(0)
+    raw = jax.device_get(_batch(jax.random.key(1), cfg))
+
+    mesh_dp = pmesh.make_mesh(MeshConfig())
+    s_dp = _make_state(cfg, models, params, mesh_dp)
+    f_dp = T.make_train_step(cfg, models, mesh_dp)
+    s_dp, m_dp = f_dp(s_dp, pmesh.shard_batch(mesh_dp, raw), key)
+
+    mesh_f = pmesh.make_mesh(MeshConfig(data=-1, fsdp=2))
+    s_f = _make_state(cfg, models, params, mesh_f)
+    f_f = T.make_train_step(cfg, models, mesh_f)
+    s_f, m_f = f_f(s_f, pmesh.shard_batch(mesh_f, raw), key)
+
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_f["loss"]), rtol=1e-5)
+
+
+def test_mitigations_change_loss(setup, cpu_devices):
+    cfg, models, params = setup
+    mesh = pmesh.make_mesh(MeshConfig())
+    key = rngmod.root_key(0)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg)))
+
+    base_state = _make_state(cfg, models, params, mesh)
+    _, m0 = T.make_train_step(cfg, models, mesh)(base_state, batch, key)
+
+    cfg_noise = _cfg(rand_noise_lam=0.5)
+    cfg_noise.model = cfg.model
+    s = _make_state(cfg_noise, models, params, mesh)
+    _, m1 = T.make_train_step(cfg_noise, models, mesh)(s, batch, key)
+    assert float(m1["loss"]) != float(m0["loss"])
+
+    cfg_mix = _cfg(mixup_noise_lam=0.3)
+    cfg_mix.model = cfg.model
+    s = _make_state(cfg_mix, models, params, mesh)
+    _, m2 = T.make_train_step(cfg_mix, models, mesh)(s, batch, key)
+    assert float(m2["loss"]) != float(m0["loss"])
+
+
+def test_v_prediction_target(setup, cpu_devices):
+    cfg, models, params = setup
+    import dataclasses
+
+    cfg_v = _cfg()
+    cfg_v.model = dataclasses.replace(cfg.model, prediction_type="v_prediction")
+    models_v, params_v = build_models(cfg_v, jax.random.key(0))
+    mesh = pmesh.make_mesh(MeshConfig())
+    s = _make_state(cfg_v, models_v, params_v, mesh)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg_v)))
+    s, m = T.make_train_step(cfg_v, models_v, mesh)(s, batch, rngmod.root_key(0))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_gradient_accumulation(setup, cpu_devices):
+    cfg, models, params = setup
+    import dataclasses
+
+    cfg_ga = _cfg()
+    cfg_ga.model = cfg.model
+    cfg_ga.optim = dataclasses.replace(cfg_ga.optim, gradient_accumulation_steps=2)
+    mesh = pmesh.make_mesh(MeshConfig())
+    s = _make_state(cfg_ga, models, params, mesh)
+    step_fn = T.make_train_step(cfg_ga, models, mesh)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg_ga)))
+    before = np.asarray(jax.tree.leaves(s.unet_params)[0])  # materialize pre-donation
+    s, _ = step_fn(s, batch, rngmod.root_key(0))
+    mid = np.asarray(jax.tree.leaves(s.unet_params)[0])
+    # first micro-step: no param change yet
+    np.testing.assert_array_equal(before, mid)
+    s, _ = step_fn(s, batch, rngmod.root_key(0))
+    after = np.asarray(jax.tree.leaves(s.unet_params)[0])
+    assert not np.array_equal(mid, after)
+
+
+def test_ema_updates(setup, cpu_devices):
+    cfg, models, params = setup
+    cfg_ema = _cfg(ema_decay=0.9)
+    cfg_ema.model = cfg.model
+    mesh = pmesh.make_mesh(MeshConfig())
+    s = _make_state(cfg_ema, models, params, mesh)
+    assert s.ema_params is not None
+    step_fn = T.make_train_step(cfg_ema, models, mesh)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg_ema)))
+    p0 = np.asarray(jax.tree.leaves(s.unet_params)[0])
+    s, _ = step_fn(s, batch, rngmod.root_key(0))
+    ema1 = np.asarray(jax.tree.leaves(s.ema_params)[0])
+    p1 = np.asarray(jax.tree.leaves(s.unet_params)[0])
+    np.testing.assert_allclose(ema1, 0.9 * p0 + 0.1 * p1, atol=1e-6)
+
+
+def test_train_text_encoder_updates_text_params(setup, cpu_devices):
+    cfg, models, params = setup
+    cfg_t = _cfg(train_text_encoder=True)
+    cfg_t.model = cfg.model
+    mesh = pmesh.make_mesh(MeshConfig())
+    s = _make_state(cfg_t, models, params, mesh)
+    step_fn = T.make_train_step(cfg_t, models, mesh)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg_t)))
+    t0 = np.asarray(jax.tree.leaves(s.text_params)[0])
+    s, _ = step_fn(s, batch, rngmod.root_key(0))
+    t1 = np.asarray(jax.tree.leaves(s.text_params)[0])
+    assert not np.array_equal(t0, t1)
+    # frozen by default
+    s2 = _make_state(setup[0], models, params, mesh)
+    f2 = T.make_train_step(setup[0], models, mesh)
+    u0 = np.asarray(jax.tree.leaves(s2.text_params)[0])
+    s2, _ = f2(s2, batch, rngmod.root_key(0))
+    u1 = np.asarray(jax.tree.leaves(s2.text_params)[0])
+    np.testing.assert_array_equal(u0, u1)
+
+
+def test_lr_schedules():
+    from dcr_tpu.core.config import OptimConfig
+
+    sched = T.make_lr_schedule(OptimConfig(learning_rate=1e-4,
+                                           lr_scheduler="constant_with_warmup",
+                                           lr_warmup_steps=100))
+    assert float(sched(0)) == 0.0
+    assert float(sched(50)) == pytest.approx(5e-5)
+    assert float(sched(100)) == pytest.approx(1e-4)
+    assert float(sched(10000)) == pytest.approx(1e-4)
+
+
+def test_ema_gated_on_accumulation_boundary(setup, cpu_devices):
+    """Regression: EMA must blend once per optimizer update, not per micro-step."""
+    import dataclasses
+
+    cfg, models, params = setup
+    cfg_ga = _cfg(ema_decay=0.5)
+    cfg_ga.model = cfg.model
+    cfg_ga.optim = dataclasses.replace(cfg_ga.optim, gradient_accumulation_steps=2)
+    mesh = pmesh.make_mesh(MeshConfig())
+    s = _make_state(cfg_ga, models, params, mesh)
+    step_fn = T.make_train_step(cfg_ga, models, mesh)
+    batch = pmesh.shard_batch(mesh, jax.device_get(_batch(jax.random.key(1), cfg_ga)))
+    ema0 = np.asarray(jax.tree.leaves(s.ema_params)[0])
+    s, m1 = step_fn(s, batch, rngmod.root_key(0))
+    ema1 = np.asarray(jax.tree.leaves(s.ema_params)[0])
+    np.testing.assert_array_equal(ema0, ema1)  # micro-step: no EMA move
+    # lr reported as applied (first optimizer update not yet taken at micro-step 0)
+    s, m2 = step_fn(s, batch, rngmod.root_key(0))
+    ema2 = np.asarray(jax.tree.leaves(s.ema_params)[0])
+    p2 = np.asarray(jax.tree.leaves(s.unet_params)[0])
+    np.testing.assert_allclose(ema2, 0.5 * ema1 + 0.5 * p2, atol=1e-6)
