@@ -1,0 +1,87 @@
+"""Benchmark: SD-2.1 256px finetune train-step throughput on the local chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the full jitted train step (VAE-encode -> q-sample -> CLIP text encode
+-> UNet fwd+bwd -> AdamW) on the flagship SD-2.1-size stack at 256px with
+synthetic data — the workload of BASELINE.json config 2.
+
+vs_baseline compares against the reference setup's estimated throughput on its
+stated hardware (RTX-A6000, README.md:22): diffusers fp16+xformers SD-2.1
+finetune at 256px, ~28 img/s/GPU (A6000 ~155 TF/s dense fp16; the reference
+publishes no numbers — BASELINE.md — so this is the documented estimate the
+ratio is anchored to).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+A6000_REFERENCE_IMGS_PER_SEC = 28.0
+
+
+def bench(batch_size: int, steps: int = 10):
+    import jax
+    import numpy as np
+
+    from dcr_tpu.core.config import MeshConfig, ModelConfig, TrainConfig
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.diffusion import train as T
+    from dcr_tpu.diffusion.trainer import build_models
+    from dcr_tpu.parallel import mesh as pmesh
+
+    cfg = TrainConfig(mixed_precision="bf16", train_batch_size=batch_size)
+    cfg.model = ModelConfig()           # full SD-2.1 dims, 256px (32x32 latents)
+    cfg.optim.lr_warmup_steps = 0
+    cfg.mesh = MeshConfig()
+
+    mesh = pmesh.make_mesh(cfg.mesh)
+    models, params = build_models(cfg, jax.random.key(0))
+    state = T.init_train_state(cfg, models, unet_params=params["unet"],
+                               text_params=params["text"], vae_params=params["vae"])
+    state = T.shard_train_state(state, mesh)
+    step_fn = T.make_train_step(cfg, models, mesh)
+
+    n_dev = len(jax.devices())
+    bsz = batch_size * n_dev
+    rng = np.random.default_rng(0)
+    batch = pmesh.shard_batch(mesh, {
+        "pixel_values": rng.standard_normal((bsz, 256, 256, 3)).astype(np.float32),
+        "input_ids": np.ones((bsz, cfg.model.text_max_length), np.int32),
+    })
+    key = rngmod.root_key(0)
+
+    state, _ = step_fn(state, batch, key)          # compile + warmup
+    state, m = step_fn(state, batch, key)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step_fn(state, batch, key)
+    jax.block_until_ready(m["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    return bsz / dt / n_dev                        # images/sec/chip
+
+
+def main():
+    value = None
+    err = None
+    for bs in (16, 8, 4):
+        try:
+            value = bench(bs)
+            break
+        except Exception as e:  # OOM at large batch: retry smaller
+            err = e
+            continue
+    if value is None:
+        raise SystemExit(f"bench failed at all batch sizes: {err}")
+    print(json.dumps({
+        "metric": "sd21_256px_finetune_images_per_sec_per_chip",
+        "value": round(value, 3),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(value / A6000_REFERENCE_IMGS_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -48,8 +48,8 @@ class DataLoader:
                  num_workers: int = 8, seed: int = 0,
                  process_index: int = 0, process_count: int = 1,
                  drop_last: bool = True, prefetch: int = 4):
-        if batch_size % 1:
-            raise ValueError("batch_size must be int")
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.dataset = dataset
         self.global_batch_size = batch_size * process_count
         self.batch_size = batch_size

@@ -109,12 +109,16 @@ class Trainer:
 
     def export_checkpoint(self, tag: str = "checkpoint") -> Path:
         """HF-style directory-of-subfolders export (reference save format,
-        diff_train.py:709-716) for the sampler/eval stages."""
+        diff_train.py:709-716) for the sampler/eval stages. When EMA is enabled
+        the EMA weights are what gets exported (they're the point of EMA —
+        sampling uses them, matching the diffusers copy-into-unet-on-save flow)."""
         out = self.out_dir / tag
+        unet_to_export = (self.state.ema_params if self.state.ema_params is not None
+                          else self.state.unet_params)
         if dist.is_primary():
             export_hf_layout(
                 out,
-                unet=jax.device_get(self.state.unet_params),
+                unet=jax.device_get(unet_to_export),
                 vae=jax.device_get(self.state.vae_params),
                 text_encoder=jax.device_get(self.state.text_params),
                 scheduler_config={

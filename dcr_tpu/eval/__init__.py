@@ -1,0 +1,2 @@
+"""L4c: replication metrics — sharded features, similarity stats, FID, CLIP
+score, complexity correlations, precision/recall, galleries."""

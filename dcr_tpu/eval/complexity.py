@@ -1,0 +1,88 @@
+"""Image-complexity measures + similarity correlations.
+
+Reference: diff_retrieval.py:497-559 — for each generation's top-1 train match,
+compute three complexity proxies of the matched training image and Pearson-
+correlate each against the top-1 similarity:
+
+- grayscale Shannon entropy (skimage.measure.shannon_entropy equivalent)
+- JPEG-compressed byte size (cv2.imencode at diff_retrieval.py:512-515; here
+  the native C++ helper dcr_tpu.native.jpeg_size when built, else PIL)
+- total variation (tv_loss, diff_retrieval.py:113-121)
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Sequence
+
+import numpy as np
+from PIL import Image
+
+
+def shannon_entropy(image: np.ndarray) -> float:
+    """Grayscale Shannon entropy in bits. image: [H,W,3] float [0,1] or uint8."""
+    arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        arr = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
+    gray = np.round(arr.astype(np.float64) @ np.array([0.2125, 0.7154, 0.0721])
+                    ).astype(np.uint8)
+    counts = np.bincount(gray.ravel(), minlength=256)
+    p = counts[counts > 0] / gray.size
+    return float(-np.sum(p * np.log2(p)))
+
+
+def jpeg_size(image: np.ndarray, quality: int = 95) -> int:
+    """JPEG-compressed size in bytes (complexity proxy)."""
+    arr = np.asarray(image)
+    if arr.dtype != np.uint8:
+        arr = (np.clip(arr, 0, 1) * 255).astype(np.uint8)
+    try:
+        from dcr_tpu.native import jpeg_helper
+
+        size = jpeg_helper.encoded_size(arr, quality)
+        if size is not None:
+            return size
+    except Exception:
+        pass
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
+    return buf.tell()
+
+
+def tv_loss(image: np.ndarray) -> float:
+    """Anisotropic total variation, mean absolute difference of neighbors
+    (reference tv_loss semantics, diff_retrieval.py:113-121)."""
+    arr = np.asarray(image, np.float64)
+    dh = np.abs(arr[1:, :] - arr[:-1, :]).mean()
+    dw = np.abs(arr[:, 1:] - arr[:, :-1]).mean()
+    return float(dh + dw)
+
+
+def pearson(x: Sequence[float], y: Sequence[float]) -> float:
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    if len(x) < 2 or x.std() == 0 or y.std() == 0:
+        return float("nan")
+    return float(np.corrcoef(x, y)[0, 1])
+
+
+def complexity_correlations(match_images: Sequence[np.ndarray],
+                            top1_sims: Sequence[float]) -> tuple[dict, dict]:
+    """The reference's four wandb scalars (diff_retrieval.py:530-540):
+    correlations of top-1 similarity with entropy / jpeg size / tv / all pairs.
+    Returns (scalars, per_image_series) so callers can reuse the series for
+    scatter plots without recomputing."""
+    entropies = [shannon_entropy(im) for im in match_images]
+    sizes = [float(jpeg_size(im)) for im in match_images]
+    tvs = [tv_loss(im) for im in match_images]
+    scalars = {
+        "corr_entropy_sim": pearson(entropies, top1_sims),
+        "corr_jpegsize_sim": pearson(sizes, top1_sims),
+        "corr_tv_sim": pearson(tvs, top1_sims),
+        "corr_entropy_jpegsize": pearson(entropies, sizes),
+        "mean_entropy": float(np.mean(entropies)) if entropies else float("nan"),
+        "mean_jpeg_bytes": float(np.mean(sizes)) if sizes else float("nan"),
+        "mean_tv": float(np.mean(tvs)) if tvs else float("nan"),
+    }
+    series = {"entropy": entropies, "jpeg_bytes": sizes, "tv": tvs}
+    return scalars, series

@@ -1,0 +1,169 @@
+"""Sharded batch feature extraction over image directories.
+
+TPU re-design of the reference's distributed embedding loop
+(utils_ret.py:704-787: DistributedSampler + per-rank forward + async all_gather
+into a rank-0 matrix). Here the batch axis is GSPMD-sharded over the mesh and a
+jitted forward produces globally-addressable features directly — no gather code,
+no rank-0 special case (SURVEY.md §3.5). Includes the 3-scale `multi_scale`
+pooling option (utils_ret.py:676-698).
+
+Also provides SynthDataset's role (diff_retrieval.py:61-111): an eval-side image
+folder (flat generations dir with prompts.txt, or a class-tree train dir with
+caption json) yielding resize/center-crop/normalized tensors plus captions.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Iterator, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from PIL import Image
+
+from dcr_tpu.data.dataset import IMG_EXTENSIONS, _resize_shorter_side
+from dcr_tpu.parallel import mesh as pmesh
+
+
+def natsort_key(path: Path):
+    """Natural sort (gen_0, gen_2, gen_10) — the reference depends on natsort
+    ordering generations to align with prompts.txt lines."""
+    import re
+
+    return [int(t) if t.isdigit() else t for t in re.split(r"(\d+)", path.name)]
+
+
+class EvalImageFolder:
+    """Flat or class-tree image dir with optional captions.
+
+    - generations dir: flat files + sibling prompts.txt (one line per prompt,
+      images ordered naturally; im_batch images per prompt are supported by
+      integer-dividing the image index, matching the reference's SynthDataset
+      prompt lookup).
+    - train dir: class subdirectories + caption json keyed by path.
+    """
+
+    def __init__(self, root: str | Path, image_size: int = 224, *,
+                 caption_json: Optional[str | Path] = None,
+                 normalize: Optional[tuple[Sequence[float], Sequence[float]]] = None):
+        self.root = Path(root)
+        self.image_size = image_size
+        self.normalize = normalize
+        flat = sorted([p for p in self.root.iterdir()
+                       if p.suffix.lower() in IMG_EXTENSIONS], key=natsort_key) \
+            if self.root.exists() else []
+        if flat:
+            self.paths = flat
+        else:
+            self.paths = sorted(p for p in self.root.rglob("*")
+                                if p.suffix.lower() in IMG_EXTENSIONS)
+        if not self.paths:
+            raise FileNotFoundError(f"no images under {root}")
+        self.captions: Optional[list[str]] = None
+        if caption_json is not None:
+            table = json.loads(Path(caption_json).read_text())
+            # index by several path representations: the table was written with
+            # the *training* run's path strings, which may be relative while
+            # ours are absolute (or vice versa)
+            lookup: dict[str, str] = {}
+            for key, caps in table.items():
+                cap = str(caps[0]) if caps else ""
+                kp = Path(key)
+                for alias in (str(kp), str(kp.resolve()), kp.name):
+                    lookup.setdefault(alias, cap)
+            self.captions = []
+            misses = 0
+            for p in self.paths:
+                for alias in (str(p), str(p.resolve()), p.name):
+                    if alias in lookup:
+                        self.captions.append(lookup[alias])
+                        break
+                else:
+                    self.captions.append("")
+                    misses += 1
+            if misses:
+                import logging
+
+                logging.getLogger("dcr_tpu").warning(
+                    "caption json %s matched only %d/%d images under %s — "
+                    "clip scores over the misses are meaningless",
+                    caption_json, len(self.paths) - misses, len(self.paths), root)
+        else:
+            # the sampling pipeline writes prompts.txt NEXT TO generations/
+            # (reference layout, diff_inference.py:179-181); accept either spot
+            prompts_file = self.root / "prompts.txt"
+            if not prompts_file.exists():
+                prompts_file = self.root.parent / "prompts.txt"
+            if prompts_file.exists():
+                prompts = prompts_file.read_text().splitlines()
+                per = max(1, len(self.paths) // max(1, len(prompts)))
+                self.captions = [prompts[min(i // per, len(prompts) - 1)]
+                                 for i in range(len(self.paths))]
+
+    def __len__(self) -> int:
+        return len(self.paths)
+
+    def load(self, i: int) -> np.ndarray:
+        with Image.open(self.paths[i]) as img:
+            img = img.convert("RGB")
+            img = _resize_shorter_side(img, self.image_size)
+            w, h = img.size
+            left, top = (w - self.image_size) // 2, (h - self.image_size) // 2
+            img = img.crop((left, top, left + self.image_size, top + self.image_size))
+            arr = np.asarray(img, np.float32) / 255.0
+        if self.normalize is not None:
+            mean, std = self.normalize
+            arr = (arr - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+        return arr
+
+    def batches(self, batch_size: int, pad_to: Optional[int] = None
+                ) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """(images [B,H,W,3], valid_mask [B]) — last batch padded for jit."""
+        pad_to = pad_to or batch_size
+        for start in range(0, len(self), batch_size):
+            idx = list(range(start, min(start + batch_size, len(self))))
+            imgs = np.stack([self.load(i) for i in idx])
+            mask = np.ones(len(idx), bool)
+            if len(idx) < pad_to:
+                fill = pad_to - len(idx)
+                imgs = np.concatenate([imgs, np.repeat(imgs[-1:], fill, 0)])
+                mask = np.concatenate([mask, np.zeros(fill, bool)])
+            yield imgs, mask
+
+
+def make_extractor(apply_fn: Callable, params, mesh, *, multiscale: bool = False):
+    """Jitted, mesh-sharded feature extractor: images [B,H,W,3] -> [B, D]."""
+    batch_spec = pmesh.batch_sharding(mesh)
+
+    def forward(images):
+        images = jax.lax.with_sharding_constraint(images, batch_spec)
+        if not multiscale:
+            return apply_fn(params, images)
+        # 3-scale pooled features (reference utils_ret.py:676-698):
+        # mean of features at scales {1, 1/sqrt(2), 1/2}, then L2 normalized
+        acc = None
+        b, h, w, c = images.shape
+        for s in (1.0, 2 ** -0.5, 0.5):
+            if s == 1.0:
+                inp = images
+            else:
+                nh, nw = int(h * s), int(w * s)
+                inp = jax.image.resize(images, (b, nh, nw, c), method="bilinear")
+            feats = apply_fn(params, inp)
+            acc = feats if acc is None else acc + feats
+        acc = acc / 3.0
+        return acc / jnp.linalg.norm(acc, axis=-1, keepdims=True)
+
+    return jax.jit(forward)
+
+
+def extract_features(folder: EvalImageFolder, extractor, *,
+                     batch_size: int = 64) -> np.ndarray:
+    """[N, D] features for every image in the folder, in folder order."""
+    chunks = []
+    for images, mask in folder.batches(batch_size):
+        feats = pmesh.to_host(extractor(jnp.asarray(images)))
+        chunks.append(feats[mask])
+    return np.concatenate(chunks, axis=0)

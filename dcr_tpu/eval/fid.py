@@ -1,0 +1,84 @@
+"""Fréchet Inception Distance: activation statistics + Fréchet math + caching.
+
+Math parity with the reference's metrics/fid.py:142-236 (pytorch-fid):
+FID = |mu1-mu2|² + tr(S1 + S2 - 2 sqrtm(S1 S2)), with the trace term computed
+on host in float64. Instead of scipy.linalg.sqrtm on the (possibly
+non-symmetric) product, we use the PSD identity
+tr sqrtm(S1 S2) = sum sqrt eig(sqrtm(S1) S2 sqrtm(S1)) via two symmetric
+eigendecompositions — numerically stabler than sqrtm's Schur iteration and
+equivalent for covariance matrices (both S1, S2 PSD). The .npz statistics cache
+(reference 226-236, 258-275) is kept.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("dcr_tpu")
+
+
+def activation_statistics(features: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(mu [D], sigma [D,D]) in float64 (reference fid.py:199-223)."""
+    feats = np.asarray(features, np.float64)
+    mu = feats.mean(axis=0)
+    sigma = np.cov(feats, rowvar=False)
+    return mu, sigma
+
+
+def _sym_sqrtm(mat: np.ndarray, eps: float = 1e-12) -> np.ndarray:
+    vals, vecs = np.linalg.eigh(mat)
+    vals = np.clip(vals, 0.0, None)
+    return (vecs * np.sqrt(vals + eps)) @ vecs.T
+
+
+def frechet_distance(mu1: np.ndarray, sigma1: np.ndarray, mu2: np.ndarray,
+                     sigma2: np.ndarray, eps: float = 1e-6) -> float:
+    """Reference math (fid.py:142-196) with the eigh-based trace term; the
+    same eps*I fallback is applied when covariances are near-singular."""
+    mu1, mu2 = np.atleast_1d(mu1), np.atleast_1d(mu2)
+    sigma1, sigma2 = np.atleast_2d(sigma1), np.atleast_2d(sigma2)
+    diff = mu1 - mu2
+
+    s1 = _sym_sqrtm(sigma1)
+    inner = s1 @ sigma2 @ s1
+    vals = np.linalg.eigvalsh(inner)
+    if not np.isfinite(vals).all() or vals.min() < -1e-3 * max(1.0, abs(vals.max())):
+        log.warning("FID: ill-conditioned covariances; adding eps=%g to diagonals", eps)
+        off = eps * np.eye(sigma1.shape[0])
+        s1 = _sym_sqrtm(sigma1 + off)
+        inner = s1 @ (sigma2 + off) @ s1
+        vals = np.linalg.eigvalsh(inner)
+    tr_covmean = np.sum(np.sqrt(np.clip(vals, 0.0, None)))
+    return float(diff @ diff + np.trace(sigma1) + np.trace(sigma2) - 2.0 * tr_covmean)
+
+
+def save_stats(path: str | Path, mu: np.ndarray, sigma: np.ndarray) -> None:
+    np.savez(path, mu=mu, sigma=sigma)
+
+
+def load_stats(path: str | Path) -> tuple[np.ndarray, np.ndarray]:
+    with np.load(path) as z:
+        return z["mu"], z["sigma"]
+
+
+def fid_from_features(feats1: np.ndarray, feats2: np.ndarray, *,
+                      cache1: Optional[str | Path] = None,
+                      cache2: Optional[str | Path] = None) -> float:
+    """FID between two activation sets, with optional .npz stat caches
+    (reference calculate_fid_given_paths + save_fid_stats, fid.py:239-275)."""
+
+    def stats(feats, cache):
+        if cache is not None and Path(cache).exists():
+            return load_stats(cache)
+        mu, sigma = activation_statistics(feats)
+        if cache is not None:
+            save_stats(cache, mu, sigma)
+        return mu, sigma
+
+    mu1, s1 = stats(feats1, cache1)
+    mu2, s2 = stats(feats2, cache2)
+    return frechet_distance(mu1, s1, mu2, s2)

@@ -1,0 +1,144 @@
+"""Retrieval galleries + plots.
+
+Reference: diff_retrieval.py:608-640 (ranked match grids: rows of
+[query | top-k train matches], paged by similarity rank, 10 rows per page) and
+666-676 (`gallery` horizontal concat); histogram/scatter/bar plots at
+425-436, 542-583. Also covers the missing `utils.draw_utils.concat_h` the
+reference imports but doesn't ship (diff_train.py:27 — SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+from PIL import Image
+
+
+def concat_h(images: Sequence[Image.Image], pad: int = 2,
+             background: tuple[int, int, int] = (255, 255, 255)) -> Image.Image:
+    """Horizontal concatenation of PIL images (the reference's missing helper)."""
+    if not images:
+        raise ValueError("no images to concat")
+    h = max(im.height for im in images)
+    w = sum(im.width for im in images) + pad * (len(images) - 1)
+    out = Image.new("RGB", (w, h), background)
+    x = 0
+    for im in images:
+        out.paste(im, (x, (h - im.height) // 2))
+        x += im.width + pad
+    return out
+
+
+def concat_v(images: Sequence[Image.Image], pad: int = 2,
+             background: tuple[int, int, int] = (255, 255, 255)) -> Image.Image:
+    if not images:
+        raise ValueError("no images to concat")
+    w = max(im.width for im in images)
+    h = sum(im.height for im in images) + pad * (len(images) - 1)
+    out = Image.new("RGB", (w, h), background)
+    y = 0
+    for im in images:
+        out.paste(im, ((w - im.width) // 2, y))
+        y += im.height + pad
+    return out
+
+
+def _load_thumb(path: str | Path, size: int) -> Image.Image:
+    with Image.open(path) as im:
+        return im.convert("RGB").resize((size, size), Image.BILINEAR)
+
+
+def ranked_galleries(query_paths: Sequence, train_paths: Sequence,
+                     top1: np.ndarray, topk_idx: np.ndarray, out_dir: str | Path,
+                     *, rows_per_page: int = 10, max_rank: int = 200,
+                     thumb: int = 128) -> list[Path]:
+    """Grids of [query | its top-k matches], queries ordered by descending
+    top-1 similarity, paged `rows_per_page` per image (reference 608-640)."""
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    order = np.argsort(-np.asarray(top1))[:max_rank]
+    pages: list[Path] = []
+    for page_start in range(0, len(order), rows_per_page):
+        rows = []
+        for qi in order[page_start:page_start + rows_per_page]:
+            imgs = [_load_thumb(query_paths[qi], thumb)]
+            imgs += [_load_thumb(train_paths[ti], thumb) for ti in topk_idx[qi]]
+            rows.append(concat_h(imgs))
+        page = concat_v(rows)
+        path = out_dir / f"gallery_rank{page_start}_{page_start + len(rows) - 1}.png"
+        page.save(path)
+        pages.append(path)
+    return pages
+
+
+def image_grid(images: Sequence[np.ndarray], cols: int) -> Image.Image:
+    """Grid from float [0,1] arrays — the trainer's periodic sample grids
+    (reference diff_train.py:673-701 uses the missing concat_h for this)."""
+    pil = [Image.fromarray((np.clip(a, 0, 1) * 255).astype(np.uint8))
+           for a in images]
+    rows = [concat_h(pil[i:i + cols]) for i in range(0, len(pil), cols)]
+    return concat_v(rows)
+
+
+def histogram_plot(gen_top1: np.ndarray, bg_top1: np.ndarray,
+                   out_path: str | Path) -> Optional[Path]:
+    """sim(gen,train) vs sim(train,train) density histogram
+    (reference 425-436). Returns None if matplotlib is unavailable."""
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        return None
+    bins = np.linspace(0, 1, 200)
+    plt.figure(figsize=(6, 4))
+    plt.hist(gen_top1, bins, alpha=0.4, label="sim(gen,train)", density=True)
+    plt.hist(bg_top1, bins, alpha=0.6, label="sim(train,train)", density=True)
+    plt.legend(loc="upper right")
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    plt.savefig(out_path)
+    plt.close()
+    return out_path
+
+
+def scatter_plot(x: np.ndarray, y: np.ndarray, xlabel: str, ylabel: str,
+                 out_path: str | Path) -> Optional[Path]:
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        return None
+    plt.figure(figsize=(5, 4))
+    plt.scatter(x, y, s=4, alpha=0.5)
+    plt.xlabel(xlabel)
+    plt.ylabel(ylabel)
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    plt.savefig(out_path)
+    plt.close()
+    return out_path
+
+
+def dup_barplot(dup_mean: float, nondup_mean: float,
+                out_path: str | Path) -> Optional[Path]:
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except Exception:
+        return None
+    plt.figure(figsize=(4, 4))
+    plt.bar(["duplicated", "not duplicated"], [dup_mean, nondup_mean])
+    plt.ylabel("mean top-1 similarity")
+    out_path = Path(out_path)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    plt.savefig(out_path)
+    plt.close()
+    return out_path

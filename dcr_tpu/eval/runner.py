@@ -1,0 +1,211 @@
+"""The eval driver: backbone zoo -> features -> every copying metric -> plots.
+
+Library equivalent of diff_retrieval.py:main_worker (224-640), minus the
+process-spawn machinery (GSPMD replaces it, SURVEY.md §3.5). Pipeline:
+
+1. backbone by (pt_style, arch): sscd | dino | clip (249-285)
+2. sharded feature extraction of query (generations) and values (train) dirs
+3. L2-normalize, similarity matrix, gen↔train + train↔train stats (388-483)
+4. CLIP alignment scores for both dirs (484-495)
+5. complexity↔similarity correlations over top-1 matches (497-559)
+6. duplicated-vs-not analysis off the training weights pickle (561-583)
+7. FID (586-605), precision/recall (the reference imports but never runs IPR;
+   here it's wired, diff_retrieval.py:587/602-603)
+8. ranked galleries + plots (608-640)
+
+All scalars keep the reference's wandb names so dashboards compare 1:1.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+from pathlib import Path
+from typing import Optional
+
+import jax
+import numpy as np
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.config import EvalConfig
+from dcr_tpu.core.metrics import MetricWriter
+from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
+from dcr_tpu.eval import complexity as CX
+from dcr_tpu.eval import fid as FID
+from dcr_tpu.eval import gallery as G
+from dcr_tpu.eval import ipr as IPR
+from dcr_tpu.eval import similarity as SIM
+from dcr_tpu.eval.features import EvalImageFolder, extract_features, make_extractor
+from dcr_tpu.models.clip_image import CLIPImageTower, init_clip_scorer, make_clip_scorer
+from dcr_tpu.models.inception import InceptionV3FID
+from dcr_tpu.models.resnet import SSCDModel
+from dcr_tpu.models.vgg import VGG16Features
+from dcr_tpu.models.vit import DINO_ARCHS
+from dcr_tpu.parallel import mesh as pmesh
+
+log = logging.getLogger("dcr_tpu")
+
+
+def build_backbone(pt_style: str, arch: str, key: jax.Array,
+                   params: Optional[dict] = None, image_size: int = 224):
+    """(apply_fn, params) for the copy-detection embedder
+    (reference model zoo switch, diff_retrieval.py:249-285). Random init unless
+    converted pretrained params are supplied (models/convert.py)."""
+    import jax.numpy as jnp
+
+    if pt_style == "sscd":
+        model = SSCDModel(embed_dim=512)
+        if params is None:
+            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
+        return (lambda p, x: model.apply({"params": p}, x)), params
+    if pt_style == "dino":
+        if arch not in DINO_ARCHS:
+            raise ValueError(f"unknown dino arch {arch!r} (have {sorted(DINO_ARCHS)})")
+        model = DINO_ARCHS[arch]()
+        if params is None:
+            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
+        return (lambda p, x: model.apply({"params": p}, x)), params
+    if pt_style == "clip":
+        model = CLIPImageTower()
+        if params is None:
+            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
+        return (lambda p, x: model.apply({"params": p}, x)), params
+    raise ValueError(f"unknown pt_style {pt_style!r} (sscd | dino | clip)")
+
+
+def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
+                         mesh, *, scorer_params=None, batch_size: int = 32,
+                         clip_image_size: int = 224) -> float:
+    """Mean CLIP cosine between each image and its caption
+    (reference gen_clipscore, utils_ret.py:1045-1066)."""
+    import jax.numpy as jnp
+
+    if folder.captions is None:
+        return float("nan")
+    scorer = make_clip_scorer()
+    if scorer_params is None:
+        scorer_params = init_clip_scorer(jax.random.key(7), scorer, clip_image_size)
+    score_fn = jax.jit(lambda p, im, ids: scorer.score(p, im, ids))
+    scores = []
+    for start in range(0, len(folder), batch_size):
+        idx = range(start, min(start + batch_size, len(folder)))
+        images = np.stack([folder.load(i) for i in idx])
+        if images.shape[1] != clip_image_size:
+            images = np.asarray(jax.image.resize(
+                jnp.asarray(images),
+                (len(images), clip_image_size, clip_image_size, 3), "bilinear"))
+        ids = tokenizer([folder.captions[i] for i in idx],
+                        max_length=scorer.text_config.text_max_length)
+        out = score_fn(scorer_params, jnp.asarray(images), jnp.asarray(ids))
+        scores.extend(np.asarray(jax.device_get(out)).tolist())
+    return float(np.mean(scores))
+
+
+def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
+             inception_params: Optional[dict] = None,
+             vgg_params: Optional[dict] = None,
+             tokenizer: Optional[TokenizerBase] = None,
+             query_caption_json: Optional[str] = None,
+             values_caption_json: Optional[str] = None) -> dict:
+    """Full metric pass; returns the scalar dict (and writes plots/galleries)."""
+    dist.initialize()
+    import jax.numpy as jnp
+
+    mesh = pmesh.make_mesh(cfg.mesh)
+    out_dir = Path(cfg.output_dir)
+    writer = MetricWriter(out_dir / "logs")
+    tokenizer = tokenizer or load_tokenizer(None)
+
+    query = EvalImageFolder(cfg.query_dir, cfg.image_size,
+                            caption_json=query_caption_json)
+    values = EvalImageFolder(cfg.values_dir, cfg.image_size,
+                             caption_json=values_caption_json)
+    log.info("eval: %d query (gen) vs %d values (train)", len(query), len(values))
+
+    apply_fn, params = build_backbone(cfg.pt_style, cfg.arch, jax.random.key(0),
+                                      backbone_params, cfg.image_size)
+    extractor = make_extractor(apply_fn, params, mesh, multiscale=cfg.multiscale)
+    query_feats = SIM.l2_normalize(extract_features(query, extractor,
+                                                    batch_size=cfg.batch_size))
+    values_feats = SIM.l2_normalize(extract_features(values, extractor,
+                                                     batch_size=cfg.batch_size))
+
+    sim = SIM.similarity_matrix(values_feats, query_feats,
+                                metric=cfg.similarity_metric,
+                                num_chunks=cfg.num_loss_chunks,
+                                chunk_style=cfg.chunk_style)
+    stats = SIM.gen_train_stats(sim)
+    scalars: dict = stats.scalars()
+    bg = SIM.train_train_background(values_feats)
+    scalars.update(SIM.background_stats(bg))
+    if dist.is_primary():
+        out_dir.mkdir(parents=True, exist_ok=True)
+        np.save(out_dir / "similarity.npy", sim)
+        G.histogram_plot(stats.top1, bg, out_dir / "histogram.png")
+
+    if cfg.compute_clip_score:
+        scalars["gen_clipscore"] = clip_alignment_score(query, tokenizer, mesh)
+        scalars["train_clipscore"] = clip_alignment_score(values, tokenizer, mesh)
+
+    if cfg.compute_complexity:
+        match_images = [values.load(i) for i in stats.top1_index]
+        cx, series = CX.complexity_correlations(match_images, stats.top1)
+        scalars.update(cx)
+        if dist.is_primary():
+            G.scatter_plot(np.asarray(series["entropy"]), stats.top1,
+                           "match entropy", "top1 sim",
+                           out_dir / "scatter_entropy.png")
+            G.scatter_plot(np.asarray(series["jpeg_bytes"]), stats.top1,
+                           "match jpeg bytes", "top1 sim",
+                           out_dir / "scatter_jpegsize.png")
+            G.scatter_plot(np.asarray(series["tv"]), stats.top1,
+                           "match total variation", "top1 sim",
+                           out_dir / "scatter_tv.png")
+
+    if cfg.dup_weights_pickle:
+        with open(cfg.dup_weights_pickle, "rb") as f:
+            weights = np.asarray(pickle.load(f))
+        dup = SIM.dup_vs_nondup_means(stats.top1, stats.top1_index, weights)
+        scalars.update(dup)
+        if dist.is_primary():
+            G.dup_barplot(dup["dupsim_mean"], dup["nondupsim_mean"],
+                          out_dir / "dup_barplot.png")
+
+    if cfg.compute_fid:
+        inception = InceptionV3FID()
+        if inception_params is None:
+            inception_params = inception.init(
+                jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]
+        fid_extract = make_extractor(
+            lambda p, x: inception.apply({"params": p}, x), inception_params, mesh)
+        q_raw = EvalImageFolder(cfg.query_dir, 299)
+        v_raw = EvalImageFolder(cfg.values_dir, 299)
+        q_act = extract_features(q_raw, fid_extract, batch_size=50)
+        v_act = extract_features(v_raw, fid_extract, batch_size=50)
+        scalars["FID_val"] = FID.fid_from_features(
+            v_act, q_act, cache1=out_dir / "fid_stats_values.npz")
+        # precision/recall on VGG16-fc2 features, like the reference's IPR
+        # (metrics/ipr.py:41) — NOT the Inception activations
+        vgg = VGG16Features()
+        if vgg_params is None:
+            vgg_params = vgg.init(jax.random.key(2),
+                                  jnp.zeros((1, 224, 224, 3)))["params"]
+        vgg_extract = make_extractor(
+            lambda p, x: vgg.apply({"params": p}, x), vgg_params, mesh)
+        q224 = EvalImageFolder(cfg.query_dir, 224)
+        v224 = EvalImageFolder(cfg.values_dir, 224)
+        scalars.update(IPR.precision_recall(
+            extract_features(v224, vgg_extract, batch_size=cfg.batch_size),
+            extract_features(q224, vgg_extract, batch_size=cfg.batch_size)))
+
+    if cfg.galleries and dist.is_primary():
+        _, idx = SIM.topk_matches(sim, cfg.gallery_topk)
+        G.ranked_galleries(query.paths, values.paths, stats.top1, idx,
+                           out_dir / "galleries", rows_per_page=cfg.gallery_rows,
+                           max_rank=cfg.gallery_max_rank)
+
+    writer.scalars(0, {k: v for k, v in scalars.items()
+                       if isinstance(v, (int, float))})
+    writer.close()
+    log.info("eval scalars: %s", scalars)
+    return scalars

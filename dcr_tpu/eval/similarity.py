@@ -1,0 +1,158 @@
+"""Similarity matrices + the copying statistics.
+
+The metrics engine of the reference (diff_retrieval.py:391-483):
+
+- ``dotproduct``: sim = values @ queryᵀ on L2-normalized features (402-403)
+- ``splitloss``: features split into C chunks, per-chunk einsum
+  'ncp,mcp->nmc', max over chunks (393-400); chunked variants incl. the
+  'cross' style of einsum_in_chunks (643-662)
+- gen↔train stats: mean/std/75/90/95th percentiles and the headline
+  ``sim_gt_05pc`` = fraction of generations with top-1 train similarity > 0.5
+  (454-468)
+- train↔train background: top-2 minus self (418-419)
+
+On TPU the matmul runs jitted (sharded when the mesh has multiple chips) —
+the rank-0-only einsum-chunking workaround disappears (SURVEY.md §3.5), though
+query chunking is kept for N×M that exceed memory.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def l2_normalize(x: np.ndarray, axis: int = -1, eps: float = 1e-12) -> np.ndarray:
+    return x / np.maximum(np.linalg.norm(x, axis=axis, keepdims=True), eps)
+
+
+def similarity_matrix(values: np.ndarray, query: np.ndarray, *,
+                      metric: str = "dotproduct", num_chunks: int = 1,
+                      chunk_style: str = "max",
+                      block_size: int = 8192) -> np.ndarray:
+    """sim [N_query, N_train] (note: transposed vs the reference's internal
+    [values, query] layout; this is the simscores orientation it analyzes)."""
+    values = jnp.asarray(values)
+    query = jnp.asarray(query)
+
+    if metric == "dotproduct":
+        f = jax.jit(lambda q, v: q @ v.T)
+    elif metric == "splitloss":
+        n, d = values.shape
+        if d % num_chunks:
+            raise ValueError(f"feature dim {d} not divisible by {num_chunks} chunks")
+        p = d // num_chunks
+
+        def split_sim(q, v):
+            qc = q.reshape(q.shape[0], num_chunks, p)
+            vc = v.reshape(v.shape[0], num_chunks, p)
+            if chunk_style == "cross":
+                # all chunk pairs, max over both (reference 'cross' style,
+                # diff_retrieval.py:653-655)
+                chunk_dp = jnp.einsum("mcp,ndp->mncd", qc, vc)
+                return jnp.max(chunk_dp, axis=(-2, -1))
+            chunk_dp = jnp.einsum("mcp,ncp->mnc", qc, vc)
+            if chunk_style == "max":
+                return jnp.max(chunk_dp, axis=-1)
+            if chunk_style == "mean":
+                return jnp.mean(chunk_dp, axis=-1)
+            raise ValueError(f"unknown chunk_style {chunk_style!r} "
+                             "(max | mean | cross)")
+
+        f = jax.jit(split_sim)
+    else:
+        raise ValueError(f"unknown similarity metric {metric!r}")
+
+    blocks = []
+    for start in range(0, query.shape[0], block_size):
+        blocks.append(np.asarray(jax.device_get(f(query[start:start + block_size],
+                                                  values))))
+    return np.concatenate(blocks, axis=0)
+
+
+@dataclass
+class SimilarityStats:
+    sim_mean: float
+    sim_std: float
+    sim_75pc: float
+    sim_90pc: float
+    sim_95pc: float
+    sim_gt_05pc: float
+    top1: np.ndarray       # [N_query] top-1 train similarity
+    top1_index: np.ndarray  # [N_query] argmax train index
+
+    def scalars(self, prefix: str = "sim") -> dict:
+        return {
+            f"{prefix}_mean": self.sim_mean, f"{prefix}_std": self.sim_std,
+            f"{prefix}_75pc": self.sim_75pc, f"{prefix}_90pc": self.sim_90pc,
+            f"{prefix}_95pc": self.sim_95pc,
+            **({"sim_gt_05pc": self.sim_gt_05pc} if prefix == "sim" else {}),
+        }
+
+
+def gen_train_stats(sim: np.ndarray, threshold: float = 0.5) -> SimilarityStats:
+    """sim: [N_query, N_train]."""
+    top1_index = np.argmax(sim, axis=1)
+    top1 = sim[np.arange(sim.shape[0]), top1_index]
+    return SimilarityStats(
+        sim_mean=float(np.mean(top1)), sim_std=float(np.std(top1)),
+        sim_75pc=float(np.percentile(top1, 75)),
+        sim_90pc=float(np.percentile(top1, 90)),
+        sim_95pc=float(np.percentile(top1, 95)),
+        sim_gt_05pc=float(np.mean(top1 > threshold)),
+        top1=top1, top1_index=top1_index,
+    )
+
+
+def train_train_background(values: np.ndarray, *, block_size: int = 8192
+                           ) -> np.ndarray:
+    """[N_train] top-1 similarity of each training image to the *rest* of the
+    training set (the reference's top-2-minus-self, diff_retrieval.py:418-419)."""
+    values_j = jnp.asarray(values)
+
+    @jax.jit
+    def block_top2(q, offset):
+        sim = q @ values_j.T
+        # mask self-similarity by index
+        n = q.shape[0]
+        rows = jnp.arange(n) + offset
+        sim = sim.at[jnp.arange(n), rows].set(-jnp.inf)
+        return jnp.max(sim, axis=1)
+
+    out = []
+    for start in range(0, values.shape[0], block_size):
+        q = values_j[start:start + block_size]
+        out.append(np.asarray(jax.device_get(block_top2(q, start))))
+    return np.concatenate(out)
+
+
+def background_stats(bg_top1: np.ndarray) -> dict:
+    return {
+        "bg_mean": float(np.mean(bg_top1)), "bg_std": float(np.std(bg_top1)),
+        "bg_75pc": float(np.percentile(bg_top1, 75)),
+        "bg_90pc": float(np.percentile(bg_top1, 90)),
+        "bg_95pc": float(np.percentile(bg_top1, 95)),
+    }
+
+
+def topk_matches(sim: np.ndarray, k: int) -> tuple[np.ndarray, np.ndarray]:
+    """(values [N,k], indices [N,k]) of the k best train matches per query."""
+    idx = np.argsort(-sim, axis=1)[:, :k]
+    vals = np.take_along_axis(sim, idx, axis=1)
+    return vals, idx
+
+
+def dup_vs_nondup_means(top1: np.ndarray, top1_index: np.ndarray,
+                        weights: np.ndarray) -> dict:
+    """Mean top-1 similarity split by whether the matched training image was
+    duplicated (reference's dup-weights barplot data, diff_retrieval.py:561-583)."""
+    matched_w = np.asarray(weights)[top1_index]
+    dup = matched_w > 1
+    return {
+        "dupsim_mean": float(np.mean(top1[dup])) if dup.any() else float("nan"),
+        "nondupsim_mean": float(np.mean(top1[~dup])) if (~dup).any() else float("nan"),
+        "dup_match_fraction": float(np.mean(dup)),
+    }

@@ -1,0 +1,109 @@
+"""CLIP image tower (ViT-B/16) + full CLIP scorer.
+
+The reference uses OpenAI CLIP twice: as a retrieval backbone
+(diff_retrieval.py:268-275, encode_image in utils_ret.py:686) and for the
+gen/train CLIP alignment score (utils_ret.py:1045-1066: cosine similarity of
+L2-normalized image and caption embeddings from ViT-B/16). The text tower
+reuses dcr_tpu.models.clip_text with CLIP-B dimensions plus the text projection.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dcr_tpu.core.config import ModelConfig
+from dcr_tpu.models.clip_text import CLIPTextModel
+from dcr_tpu.models.vit import ViTBlock
+
+
+def clip_b16_text_config(vocab_size: int = 49408) -> ModelConfig:
+    """CLIP ViT-B/16 text tower dims (512 wide, 12 layers, 8 heads)."""
+    return ModelConfig(text_vocab_size=vocab_size, text_hidden_size=512,
+                       text_layers=12, text_heads=8, text_max_length=77)
+
+
+class CLIPImageTower(nn.Module):
+    """Pre-LN ViT with class embedding and projection to the shared space."""
+
+    patch_size: int = 16
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    embed_dim: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        """[B,H,W,3] in [0,1] -> [B, embed_dim] (unnormalized)."""
+        mean = jnp.asarray([0.48145466, 0.4578275, 0.40821073], x.dtype)
+        std = jnp.asarray([0.26862954, 0.26130258, 0.27577711], x.dtype)
+        x = (x - mean) / std
+        p = self.patch_size
+        x = nn.Conv(self.width, (p, p), strides=(p, p), use_bias=False,
+                    dtype=self.dtype, name="patch_embed")(x)
+        b, gh, gw, _ = x.shape
+        tokens = x.reshape(b, gh * gw, self.width)
+        cls = self.param("class_embedding", nn.initializers.normal(0.02),
+                         (self.width,))
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.width)), tokens], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, tokens.shape[1], self.width))
+        tokens = tokens + pos.astype(self.dtype)
+        tokens = nn.LayerNorm(dtype=self.dtype, name="ln_pre")(tokens)
+        for i in range(self.layers):
+            tokens = ViTBlock(self.heads, dtype=self.dtype,
+                              name=f"blocks_{i}")(tokens)
+        cls_out = nn.LayerNorm(dtype=self.dtype, name="ln_post")(tokens[:, 0])
+        proj = self.param("proj", nn.initializers.normal(0.02),
+                          (self.width, self.embed_dim))
+        return cls_out @ proj.astype(self.dtype)
+
+
+class CLIPScorer(NamedTuple):
+    """Bundled towers for the alignment score."""
+
+    image_tower: CLIPImageTower
+    text_tower: CLIPTextModel
+    text_config: ModelConfig
+
+    def image_features(self, params, images) -> jax.Array:
+        feats = self.image_tower.apply({"params": params["image"]}, images)
+        return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+    def text_features(self, params, input_ids) -> jax.Array:
+        out = self.text_tower.apply({"params": params["text"]}, input_ids)
+        proj = params["text_projection"]
+        feats = out.pooled @ proj
+        return feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+
+    def score(self, params, images, input_ids) -> jax.Array:
+        """Per-pair cosine similarity (the reference's (img*txt).sum(-1),
+        utils_ret.py:1061)."""
+        return jnp.sum(self.image_features(params, images)
+                       * self.text_features(params, input_ids), axis=-1)
+
+
+def make_clip_scorer(embed_dim: int = 512) -> CLIPScorer:
+    tcfg = clip_b16_text_config()
+    return CLIPScorer(
+        image_tower=CLIPImageTower(embed_dim=embed_dim),
+        text_tower=CLIPTextModel(tcfg),
+        text_config=tcfg,
+    )
+
+
+def init_clip_scorer(key: jax.Array, scorer: CLIPScorer, image_size: int = 224):
+    k1, k2, k3 = jax.random.split(key, 3)
+    image_params = scorer.image_tower.init(
+        k1, jnp.zeros((1, image_size, image_size, 3)))["params"]
+    text_params = scorer.text_tower.init(
+        k2, jnp.zeros((1, scorer.text_config.text_max_length), jnp.int32))["params"]
+    proj = jax.random.normal(
+        k3, (scorer.text_config.text_hidden_size,
+             scorer.image_tower.embed_dim)) * 0.02
+    return {"image": image_params, "text": text_params, "text_projection": proj}

@@ -14,8 +14,8 @@ DPM-Solver++ (Lu et al. 2022).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
 
+import flax.struct
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -187,9 +187,9 @@ def ddim_step(sched: NoiseSchedule, model_out: jax.Array, x_t: jax.Array,
 # (diff_inference.py:93). Data-prediction formulation, order 2.
 # ---------------------------------------------------------------------------
 
-@dataclass(frozen=True)
+@flax.struct.dataclass
 class DPMState:
-    """Carried through the sampling scan."""
+    """Carried through the sampling scan (a pytree)."""
 
     prev_x0: jax.Array   # x0 prediction at the previous step
     prev_lambda: jax.Array

@@ -1,0 +1,47 @@
+"""VGG16 fc2 features — the Improved Precision & Recall embedder.
+
+Capability-equivalent of metrics/ipr.py:41's torchvision VGG16 (features up to
+fc2, 4096-d). Frozen feature extractor; weights via models/convert.py.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# torchvision vgg16 conv plan: number = out channels, "M" = 2x2 maxpool
+VGG16_PLAN = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16Features(nn.Module):
+    """[B,224,224,3] in [0,1] -> fc2 activations [B, 4096]."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        # torchvision ImageNet normalization
+        mean = jnp.asarray([0.485, 0.456, 0.406], x.dtype)
+        std = jnp.asarray([0.229, 0.224, 0.225], x.dtype)
+        x = (x - mean) / std
+        conv_i = 0
+        for item in VGG16_PLAN:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(item), (3, 3), padding=((1, 1), (1, 1)),
+                            dtype=self.dtype, name=f"conv_{conv_i}")(x)
+                x = nn.relu(x)
+                conv_i += 1
+        x = x.reshape(x.shape[0], -1)  # [B, 7*7*512]
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        return x
+
+
+def init_vgg(key: jax.Array):
+    model = VGG16Features()
+    params = model.init(key, jnp.zeros((1, 224, 224, 3)))["params"]
+    return model, params

@@ -1,0 +1,131 @@
+"""Vision Transformer (DINO-style) — alternative copy-detection backbone.
+
+Capability-equivalent of the reference's in-repo DINO ViT zoo (dino_vits.py:
+PatchEmbed 153-168, Attention 105-129, Block 132-150, VisionTransformer 171-275
+incl. positional-embedding interpolation 213-233 and get_intermediate_layers
+267-275, hub constructors 340-487). Implemented fresh in Flax/NHWC; pretrained
+DINO checkpoints load through models/convert.py.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dcr_tpu.ops.attention import dot_product_attention
+
+
+class PatchEmbed(nn.Module):
+    patch_size: int = 16
+    embed_dim: int = 768
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        p = self.patch_size
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), dtype=self.dtype,
+                    name="proj")(x)
+        b, h, w, c = x.shape
+        return x.reshape(b, h * w, c)
+
+
+class ViTBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: float = 4.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        d = x.shape[-1]
+        head_dim = d // self.num_heads
+        h = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        qkv = nn.Dense(3 * d, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        b, s, _ = q.shape
+        reshape = lambda t: t.reshape(b, s, self.num_heads, head_dim)
+        out = dot_product_attention(reshape(q), reshape(k), reshape(v),
+                                    use_flash=False)
+        out = nn.Dense(d, dtype=self.dtype, name="proj")(out.reshape(b, s, d))
+        x = x + out
+        h = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        h = nn.Dense(int(d * self.mlp_ratio), dtype=self.dtype, name="fc1")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
+        return x + h
+
+
+def interpolate_pos_embed(pos_embed: jax.Array, num_patches: int,
+                          grid_hw: tuple[int, int]) -> jax.Array:
+    """Bicubic interpolation of the patch position table to a new grid
+    (capability of reference dino_vits.py:213-233) — lets one checkpoint serve
+    any input resolution."""
+    cls_pos, patch_pos = pos_embed[:, :1], pos_embed[:, 1:]
+    n_orig = patch_pos.shape[1]
+    if n_orig == num_patches:
+        return pos_embed
+    side = int(math.sqrt(n_orig))
+    h, w = grid_hw
+    grid = patch_pos.reshape(1, side, side, -1)
+    grid = jax.image.resize(grid, (1, h, w, grid.shape[-1]), method="cubic")
+    return jnp.concatenate([cls_pos, grid.reshape(1, h * w, -1)], axis=1)
+
+
+class VisionTransformer(nn.Module):
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: float = 4.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *,
+                 return_layers: Optional[int] = None) -> jax.Array | list[jax.Array]:
+        """x: [B,H,W,3]. Returns the CLS embedding [B, D] (the reference uses
+        the cls token as the retrieval feature), or the last `return_layers`
+        full hidden states (get_intermediate_layers equivalent)."""
+        b, h, w, _ = x.shape
+        gh, gw = h // self.patch_size, w // self.patch_size
+        tokens = PatchEmbed(self.patch_size, self.embed_dim, dtype=self.dtype,
+                            name="patch_embed")(x)
+        cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.embed_dim))
+        max_grid = 224 // self.patch_size
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, max_grid * max_grid + 1, self.embed_dim))
+        pos = interpolate_pos_embed(pos, gh * gw, (gh, gw))
+        tokens = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.embed_dim)),
+                                  tokens], axis=1) + pos.astype(self.dtype)
+        outputs = []
+        for i in range(self.depth):
+            tokens = ViTBlock(self.num_heads, self.mlp_ratio, dtype=self.dtype,
+                              name=f"blocks_{i}")(tokens)
+            outputs.append(tokens)
+        norm = nn.LayerNorm(dtype=self.dtype, name="norm")
+        if return_layers:
+            return [norm(o) for o in outputs[-return_layers:]]
+        return norm(tokens)[:, 0]
+
+
+# constructors mirroring the reference's zoo (dino_vits.py:278-296,340-487)
+def vit_tiny(patch_size: int = 16, **kw) -> VisionTransformer:
+    return VisionTransformer(patch_size, 192, 12, 3, **kw)
+
+
+def vit_small(patch_size: int = 16, **kw) -> VisionTransformer:
+    return VisionTransformer(patch_size, 384, 12, 6, **kw)
+
+
+def vit_base(patch_size: int = 16, **kw) -> VisionTransformer:
+    return VisionTransformer(patch_size, 768, 12, 12, **kw)
+
+
+DINO_ARCHS = {
+    "dino_vits16": lambda: vit_small(16),
+    "dino_vits8": lambda: vit_small(8),
+    "dino_vitb16": lambda: vit_base(16),
+    "dino_vitb8": lambda: vit_base(8),
+}

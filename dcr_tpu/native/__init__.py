@@ -1,0 +1,1 @@
+"""First-party native helpers (C++, ctypes-bound)."""

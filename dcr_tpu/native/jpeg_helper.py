@@ -1,0 +1,69 @@
+"""ctypes binding for the C++ JPEG encoded-size helper.
+
+Builds lazily on first use (g++ is in the image; pybind11 is not, hence
+ctypes). Falls back to None so callers (eval.complexity.jpeg_size) can use the
+PIL path when the toolchain or libjpeg is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+log = logging.getLogger("dcr_tpu")
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / "jpeg_size.cc"
+_LIB = _HERE / "libjpeg_size.so"
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_failed
+    if _lib is not None:
+        return _lib
+    if _build_failed:
+        return None
+    if not _LIB.exists():
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-shared", "-fPIC", str(_SRC), "-o", str(_LIB),
+                 "-ljpeg"],
+                check=True, capture_output=True, timeout=120)
+        except Exception as e:
+            log.info("native jpeg helper unavailable (%s); using PIL fallback", e)
+            _build_failed = True
+            return None
+    try:
+        lib = ctypes.CDLL(str(_LIB))
+        lib.jpeg_encoded_size.restype = ctypes.c_long
+        lib.jpeg_encoded_size.argtypes = [
+            ctypes.POINTER(ctypes.c_ubyte), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int]
+        _lib = lib
+        return lib
+    except OSError as e:
+        log.info("native jpeg helper failed to load (%s)", e)
+        _build_failed = True
+        return None
+
+
+def encoded_size(image: np.ndarray, quality: int = 95) -> Optional[int]:
+    """JPEG byte count for an HxWx3 uint8 array; None if the helper is
+    unavailable (caller falls back to PIL)."""
+    lib = _load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(image, np.uint8)
+    if arr.ndim != 3 or arr.shape[2] != 3:
+        raise ValueError(f"expected HxWx3 uint8, got {arr.shape}")
+    size = lib.jpeg_encoded_size(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_ubyte)),
+        arr.shape[0], arr.shape[1], int(quality))
+    return None if size < 0 else int(size)

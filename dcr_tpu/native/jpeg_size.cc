@@ -1,0 +1,58 @@
+// JPEG encoded-size helper (libjpeg-turbo, in-memory encode, no file I/O).
+//
+// Role: the reference measures image complexity as the JPEG-compressed byte
+// size via cv2.imencode (diff_retrieval.py:512-515). The eval loop calls this
+// per matched training image; a native encode keeps the host-side metric pass
+// off the Python critical path (SURVEY.md §2.3 names this the one first-party
+// native component worth writing). Exposed through ctypes — no pybind11 in
+// this environment.
+//
+// Build: see build.py (g++ -O2 -shared -fPIC jpeg_size.cc -ljpeg).
+
+#include <cstddef>
+#include <cstdio>  // jpeglib.h needs FILE declared before inclusion
+#include <cstdlib>
+#include <cstring>
+
+#include <jpeglib.h>
+
+extern "C" {
+
+// Returns the encoded JPEG byte count for an RGB8 image, or -1 on error.
+// data: H*W*3 interleaved RGB, rows top-down.
+long jpeg_encoded_size(const unsigned char* data, int height, int width,
+                       int quality) {
+  if (data == nullptr || height <= 0 || width <= 0) return -1;
+
+  jpeg_compress_struct cinfo;
+  jpeg_error_mgr jerr;
+  cinfo.err = jpeg_std_error(&jerr);
+  jpeg_create_compress(&cinfo);
+
+  unsigned char* buffer = nullptr;
+  unsigned long buffer_size = 0;
+  jpeg_mem_dest(&cinfo, &buffer, &buffer_size);
+
+  cinfo.image_width = static_cast<JDIMENSION>(width);
+  cinfo.image_height = static_cast<JDIMENSION>(height);
+  cinfo.input_components = 3;
+  cinfo.in_color_space = JCS_RGB;
+  jpeg_set_defaults(&cinfo);
+  jpeg_set_quality(&cinfo, quality, TRUE);
+
+  jpeg_start_compress(&cinfo, TRUE);
+  const size_t stride = static_cast<size_t>(width) * 3;
+  while (cinfo.next_scanline < cinfo.image_height) {
+    JSAMPROW row =
+        const_cast<JSAMPROW>(data + cinfo.next_scanline * stride);
+    jpeg_write_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_compress(&cinfo);
+  jpeg_destroy_compress(&cinfo);
+
+  long out = static_cast<long>(buffer_size);
+  std::free(buffer);
+  return out;
+}
+
+}  // extern "C"

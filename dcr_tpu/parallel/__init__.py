@@ -10,5 +10,6 @@ from dcr_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     replicated,
     shard_batch,
+    to_host,
     use_mesh,
 )

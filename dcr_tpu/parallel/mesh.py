@@ -92,6 +92,17 @@ def fsdp_sharding_for_params(mesh: Mesh, params, min_size: int = 2 ** 16):
     return jax.tree.map(spec_for, params)
 
 
+def to_host(x) -> np.ndarray:
+    """Fetch a (possibly globally-sharded) device array to host numpy on every
+    process. Single-process: plain device_get. Multi-host: the array's shards
+    are not all addressable locally, so all-gather across processes first."""
+    if jax.process_count() == 1:
+        return np.asarray(jax.device_get(x))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
 @contextmanager
 def use_mesh(mesh: Mesh):
     with jax.sharding.use_mesh(mesh):

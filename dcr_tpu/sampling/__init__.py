@@ -1,0 +1,1 @@
+"""L4b: bulk sampling — jit-compiled scan samplers, CFG, prompt pipelines."""

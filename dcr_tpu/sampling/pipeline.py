@@ -1,0 +1,144 @@
+"""Bulk generation pipeline: checkpoint -> prompts -> sharded sampling -> PNGs.
+
+Library equivalent of diff_inference.py:main (43-201) and sd_mitigation.py:main
+(43-113): loads an HF-layout checkpoint dir (as written by Trainer.export_checkpoint,
+matching the reference's save format), builds the prompt list for the model's
+conditioning style, runs the jitted scan sampler over prompt batches, and writes
+<savepath>/generations/{count}.png + prompts.txt — the exact directory contract
+the eval stage consumes (diff_retrieval.py:125-126).
+
+Instead of parsing config back out of path substrings (the reference's
+filesystem-as-config pattern, diff_inference.py:44-81), the model's own
+config.json is read from the checkpoint dir.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from PIL import Image
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.checkpoint import import_hf_layout
+from dcr_tpu.core.config import ModelConfig, SampleConfig, from_dict
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
+from dcr_tpu.diffusion.train import DiffusionModels
+from dcr_tpu.models import schedulers as S
+from dcr_tpu.models.clip_text import CLIPTextModel
+from dcr_tpu.models.unet2d import UNet2DCondition
+from dcr_tpu.models.vae import AutoencoderKL
+from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.sampling.prompts import build_prompt_list, save_prompts
+from dcr_tpu.sampling.sampler import make_sampler
+
+log = logging.getLogger("dcr_tpu")
+
+
+def load_checkpoint_models(ckpt_dir: str | Path):
+    """(models, params) from an HF-layout dir written by Trainer.export_checkpoint.
+    Model shapes come from model_index.json (our serialized ModelConfig)."""
+    ckpt_dir = Path(ckpt_dir)
+    model_cfg = from_dict(ModelConfig, json.loads((ckpt_dir / "model_index.json").read_text()))
+    sched_cfg = json.loads((ckpt_dir / "scheduler" / "scheduler_config.json").read_text())
+    params = {
+        "unet": import_hf_layout(ckpt_dir, "unet"),
+        "vae": import_hf_layout(ckpt_dir, "vae"),
+        "text": import_hf_layout(ckpt_dir, "text_encoder"),
+    }
+    models = DiffusionModels(
+        unet=UNet2DCondition(model_cfg),
+        vae=AutoencoderKL(model_cfg),
+        text_encoder=CLIPTextModel(model_cfg),
+        schedule=S.make_schedule(
+            num_train_timesteps=sched_cfg["num_train_timesteps"],
+            beta_schedule=sched_cfg["beta_schedule"],
+            beta_start=sched_cfg["beta_start"], beta_end=sched_cfg["beta_end"],
+            prediction_type=sched_cfg["prediction_type"]),
+    )
+    return models, params, model_cfg
+
+
+def resolve_checkpoint(cfg: SampleConfig) -> Path:
+    """checkpoint_<iternum>/ or checkpoint/ under the run dir
+    (reference convention, diff_inference.py:85-88)."""
+    root = Path(cfg.model_path)
+    if (root / "unet").exists():  # already a checkpoint dir
+        return root
+    if cfg.iternum and cfg.iternum > 0:
+        cand = root / f"checkpoint_{cfg.iternum}"
+        if not cand.exists():
+            raise FileNotFoundError(f"no checkpoint_{cfg.iternum} under {root}")
+        return cand
+    cand = root / "checkpoint"
+    if not cand.exists():
+        raise FileNotFoundError(f"no exported checkpoint/ under {root} "
+                                "(run Trainer.export_checkpoint or pass iternum)")
+    return cand
+
+
+def generate(cfg: SampleConfig, *, modelstyle: str,
+             tokenizer: Optional[TokenizerBase] = None,
+             caption_json: Optional[str] = None,
+             prompts: Optional[Sequence[str]] = None,
+             models=None, params=None) -> Path:
+    """Run bulk generation; returns the savepath containing generations/."""
+    dist.initialize()
+    mesh = pmesh.make_mesh(cfg.mesh)
+    if models is None:
+        ckpt = resolve_checkpoint(cfg)
+        models, params, _ = load_checkpoint_models(ckpt)
+    tokenizer = tokenizer or load_tokenizer(
+        cfg.model_path or None,
+        vocab_size=models.text_encoder.config.text_vocab_size,
+        model_max_length=models.text_encoder.config.text_max_length)
+
+    if prompts is None:
+        prompts = build_prompt_list(
+            modelstyle, cfg.num_batches, seed=cfg.seed, tokenizer=tokenizer,
+            caption_json=caption_json,
+            rand_augs=cfg.rand_augs if cfg.rand_augs != "none" else None)
+    savepath = Path(cfg.savepath or "inferences/run")
+    gen_dir = savepath / "generations"
+    if dist.is_primary():
+        gen_dir.mkdir(parents=True, exist_ok=True)
+        save_prompts(prompts, savepath)
+
+    sampler = make_sampler(cfg, models, mesh)
+    uncond_ids = tokenizer([""])[0]
+    key = rngmod.root_key(cfg.seed)
+
+    count = 0
+    # fixed device batch (prompts_per_batch × im_batch, padded up to a multiple
+    # of the data-parallel size) so every chunk hits the same compiled program
+    dp = pmesh.data_parallel_size(mesh)
+    prompts_per_batch = max(1, len(jax.devices()) // max(1, cfg.im_batch))
+    device_batch = -(-prompts_per_batch * cfg.im_batch // dp) * dp
+    for start in range(0, len(prompts), prompts_per_batch):
+        chunk = list(prompts[start:start + prompts_per_batch])
+        ids = tokenizer(chunk)                              # [P, L]
+        ids = np.repeat(ids, cfg.im_batch, axis=0)          # [P*im_batch, L]
+        real = len(ids)
+        if real < device_batch:                             # pad to fixed batch
+            ids = np.concatenate(
+                [ids, np.repeat(ids[-1:], device_batch - real, axis=0)])
+        unc = np.broadcast_to(uncond_ids, ids.shape).copy()
+        batch_key = rngmod.step_key(rngmod.stream_key(key, "sample"), start)
+        images = pmesh.to_host(sampler(params, ids, unc, batch_key))[:real]
+        if dist.is_primary():
+            for img in images:
+                arr = (img * 255).round().astype(np.uint8)
+                im = Image.fromarray(arr)
+                if im.size[0] > cfg.resolution:  # reference resize-down (195-198)
+                    im = im.resize((cfg.resolution, cfg.resolution), Image.LANCZOS)
+                im.save(gen_dir / f"{count}.png")
+                count += 1
+        else:
+            count += len(images)
+    log.info("wrote %d generations to %s", count, gen_dir)
+    return savepath

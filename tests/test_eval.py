@@ -1,0 +1,165 @@
+import numpy as np
+import pytest
+
+from dcr_tpu.eval import complexity as CX
+from dcr_tpu.eval import fid as FID
+from dcr_tpu.eval import ipr as IPR
+from dcr_tpu.eval import similarity as SIM
+
+
+def test_similarity_dotproduct_matches_numpy(rng_np):
+    v = SIM.l2_normalize(rng_np.standard_normal((20, 16)).astype(np.float32))
+    q = SIM.l2_normalize(rng_np.standard_normal((7, 16)).astype(np.float32))
+    sim = SIM.similarity_matrix(v, q)
+    np.testing.assert_allclose(sim, q @ v.T, atol=1e-5)
+    # blocked path identical
+    sim_b = SIM.similarity_matrix(v, q, block_size=3)
+    np.testing.assert_allclose(sim_b, sim, atol=1e-6)
+
+
+def test_similarity_splitloss(rng_np):
+    v = rng_np.standard_normal((5, 8)).astype(np.float32)
+    q = rng_np.standard_normal((4, 8)).astype(np.float32)
+    sim = SIM.similarity_matrix(v, q, metric="splitloss", num_chunks=2)
+    # manual: split into 2 chunks of 4, per-chunk dot, max
+    expected = np.maximum(q[:, :4] @ v[:, :4].T, q[:, 4:] @ v[:, 4:].T)
+    np.testing.assert_allclose(sim, expected, atol=1e-5)
+    with pytest.raises(ValueError):
+        SIM.similarity_matrix(v, q, metric="splitloss", num_chunks=3)
+
+
+def test_gen_train_stats_and_threshold():
+    sim = np.array([[0.9, 0.2], [0.3, 0.4], [0.1, 0.05]])
+    stats = SIM.gen_train_stats(sim)
+    np.testing.assert_allclose(stats.top1, [0.9, 0.4, 0.1])
+    np.testing.assert_array_equal(stats.top1_index, [0, 1, 0])
+    assert stats.sim_gt_05pc == pytest.approx(1 / 3)
+    s = stats.scalars()
+    assert set(s) == {"sim_mean", "sim_std", "sim_75pc", "sim_90pc", "sim_95pc",
+                      "sim_gt_05pc"}
+
+
+def test_train_train_background_excludes_self(rng_np):
+    v = SIM.l2_normalize(rng_np.standard_normal((10, 8)).astype(np.float32))
+    bg = SIM.train_train_background(v)
+    full = v @ v.T
+    np.fill_diagonal(full, -np.inf)
+    np.testing.assert_allclose(bg, full.max(axis=1), atol=1e-5)
+    # blocked path
+    np.testing.assert_allclose(SIM.train_train_background(v, block_size=3), bg,
+                               atol=1e-5)
+
+
+def test_dup_vs_nondup_means():
+    top1 = np.array([0.9, 0.2, 0.6, 0.5])
+    idx = np.array([0, 1, 2, 1])
+    weights = np.array([5, 1, 5])
+    out = SIM.dup_vs_nondup_means(top1, idx, weights)
+    assert out["dupsim_mean"] == pytest.approx((0.9 + 0.6) / 2)
+    assert out["nondupsim_mean"] == pytest.approx((0.2 + 0.5) / 2)
+    assert out["dup_match_fraction"] == pytest.approx(0.5)
+
+
+def test_frechet_distance_identity_and_shift(rng_np):
+    feats = rng_np.standard_normal((500, 8))
+    mu, sigma = FID.activation_statistics(feats)
+    assert FID.frechet_distance(mu, sigma, mu, sigma) == pytest.approx(0.0, abs=1e-6)
+    # pure mean shift by d: FID = d^2 * dim? No: |mu1-mu2|^2 = sum of squares
+    mu2 = mu + 2.0
+    d = FID.frechet_distance(mu, sigma, mu2, sigma)
+    assert d == pytest.approx(4.0 * len(mu), rel=1e-6)
+
+
+def test_frechet_distance_matches_scipy(rng_np):
+    """Our eigh-based trace term must equal scipy.linalg.sqrtm's result."""
+    import scipy.linalg
+
+    f1 = rng_np.standard_normal((300, 6))
+    f2 = rng_np.standard_normal((300, 6)) @ np.diag([1, 2, 3, 1, 0.5, 1.5]) + 1.0
+    mu1, s1 = FID.activation_statistics(f1)
+    mu2, s2 = FID.activation_statistics(f2)
+    ours = FID.frechet_distance(mu1, s1, mu2, s2)
+    covmean = scipy.linalg.sqrtm(s1 @ s2)
+    if np.iscomplexobj(covmean):
+        covmean = covmean.real
+    ref = (np.sum((mu1 - mu2) ** 2) + np.trace(s1) + np.trace(s2)
+           - 2 * np.trace(covmean))
+    assert ours == pytest.approx(ref, rel=1e-6)
+
+
+def test_fid_stats_cache(tmp_path, rng_np):
+    f1 = rng_np.standard_normal((100, 4))
+    f2 = rng_np.standard_normal((100, 4))
+    cache = tmp_path / "stats.npz"
+    d1 = FID.fid_from_features(f1, f2, cache1=cache)
+    assert cache.exists()
+    # cache hit: garbage features for side 1 are ignored
+    d2 = FID.fid_from_features(np.zeros((10, 4)), f2, cache1=cache)
+    assert d1 == pytest.approx(d2)
+
+
+def test_ipr_precision_recall_identical_sets(rng_np):
+    feats = rng_np.standard_normal((50, 8))
+    out = IPR.precision_recall(feats, feats.copy())
+    assert out["precision"] == 1.0 and out["recall"] == 1.0
+    far = feats + 100.0
+    out2 = IPR.precision_recall(feats, far)
+    assert out2["precision"] == 0.0 and out2["recall"] == 0.0
+
+
+def test_ipr_realism(rng_np):
+    feats = rng_np.standard_normal((50, 8))
+    m = IPR.Manifold.build(feats)
+    r_in = m.realism(feats[:5] + 0.01)
+    r_out = m.realism(feats[:5] + 50.0)
+    assert np.all(r_in > r_out)
+
+
+def test_complexity_measures():
+    flat = np.zeros((64, 64, 3), np.uint8)
+    noisy = (np.random.default_rng(0).uniform(0, 255, (64, 64, 3))).astype(np.uint8)
+    assert CX.shannon_entropy(flat) == pytest.approx(0.0)
+    assert CX.shannon_entropy(noisy) > 5.0
+    assert CX.jpeg_size(noisy) > CX.jpeg_size(flat)
+    assert CX.tv_loss(noisy) > CX.tv_loss(flat)
+    corr = CX.pearson([1, 2, 3, 4], [2, 4, 6, 8])
+    assert corr == pytest.approx(1.0)
+    assert np.isnan(CX.pearson([1, 1], [2, 3]))
+
+
+def test_complexity_correlations_keys(rng_np):
+    images = [rng_np.uniform(0, 1, (32, 32, 3)).astype(np.float32) for _ in range(6)]
+    sims = rng_np.uniform(0, 1, 6)
+    out, series = CX.complexity_correlations(images, sims)
+    assert {"corr_entropy_sim", "corr_jpegsize_sim", "corr_tv_sim"} <= set(out)
+    assert set(series) == {"entropy", "jpeg_bytes", "tv"}
+    assert all(len(v) == 6 for v in series.values())
+
+
+def test_native_jpeg_helper_matches_pil_scale():
+    """If the C++ helper builds, its sizes must track PIL's (same libjpeg)."""
+    from dcr_tpu.native import jpeg_helper
+
+    noisy = (np.random.default_rng(1).uniform(0, 255, (48, 48, 3))).astype(np.uint8)
+    size = jpeg_helper.encoded_size(noisy, 95)
+    if size is None:
+        pytest.skip("native helper unavailable in this environment")
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(noisy).save(buf, format="JPEG", quality=95)
+    assert abs(size - buf.tell()) / buf.tell() < 0.1
+
+
+def test_splitloss_cross_style(rng_np):
+    v = rng_np.standard_normal((3, 8)).astype(np.float32)
+    q = rng_np.standard_normal((4, 8)).astype(np.float32)
+    sim = SIM.similarity_matrix(v, q, metric="splitloss", num_chunks=2,
+                                chunk_style="cross")
+    # manual: every chunk pair, max over all four combos
+    qc = [q[:, :4], q[:, 4:]]
+    vc = [v[:, :4], v[:, 4:]]
+    expected = np.max(np.stack([a @ b.T for a in qc for b in vc]), axis=0)
+    np.testing.assert_allclose(sim, expected, atol=1e-5)

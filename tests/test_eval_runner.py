@@ -1,0 +1,136 @@
+"""run_eval end-to-end on a synthetic generations/train pair (tiny images,
+random-init backbones — checks wiring, scalar names, artifacts; numeric
+parity with pretrained weights is the converter's job)."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcr_tpu.core.config import EvalConfig
+from dcr_tpu.data.tokenizer import HashTokenizer
+from dcr_tpu.eval.features import EvalImageFolder
+from dcr_tpu.eval.runner import run_eval
+
+
+@pytest.fixture(scope="module")
+def eval_dirs(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("evald")
+    rng = np.random.default_rng(0)
+    gen = tmp / "gens" / "generations"
+    gen.mkdir(parents=True)
+    for i in range(8):
+        Image.fromarray(rng.integers(0, 255, (40, 40, 3), np.uint8)).save(
+            gen / f"{i}.png")
+    (tmp / "gens" / "generations" / "prompts.txt").write_text(
+        "".join(f"prompt {i}\n" for i in range(4)))
+    train = tmp / "train"
+    caps = {}
+    for cls in ["c0", "c1"]:
+        d = train / cls
+        d.mkdir(parents=True)
+        for i in range(5):
+            p = d / f"{i}.png"
+            Image.fromarray(rng.integers(0, 255, (40, 40, 3), np.uint8)).save(p)
+            caps[str(p)] = [f"{cls} image {i}"]
+    capj = tmp / "caps.json"
+    capj.write_text(json.dumps(caps))
+    return tmp, gen, train, capj
+
+
+def test_eval_image_folder_prompts_alignment(eval_dirs):
+    tmp, gen, train, capj = eval_dirs
+    q = EvalImageFolder(gen, 32)
+    assert len(q) == 8
+    # 8 images / 4 prompts -> 2 per prompt
+    assert q.captions[0] == "prompt 0" and q.captions[1] == "prompt 0"
+    assert q.captions[2] == "prompt 1"
+    v = EvalImageFolder(train, 32, caption_json=capj)
+    assert len(v) == 10
+    assert v.captions[0].startswith("c0 image")
+
+
+def test_natural_ordering(tmp_path):
+    rng = np.random.default_rng(0)
+    for name in ["2.png", "10.png", "1.png"]:
+        Image.fromarray(rng.integers(0, 255, (8, 8, 3), np.uint8)).save(
+            tmp_path / name)
+    f = EvalImageFolder(tmp_path, 8)
+    assert [p.name for p in f.paths] == ["1.png", "2.png", "10.png"]
+
+
+def test_run_eval_end_to_end(eval_dirs, cpu_devices, tmp_path):
+    tmp, gen, train, capj = eval_dirs
+    cfg = EvalConfig(
+        query_dir=str(gen), values_dir=str(train),
+        pt_style="sscd", arch="resnet50_disc", batch_size=4, image_size=32,
+        compute_fid=True, compute_clip_score=True, compute_complexity=True,
+        galleries=True, gallery_topk=3, gallery_max_rank=8,
+        output_dir=str(tmp_path / "ret_plots"))
+    tok = HashTokenizer(1000, 77)
+    scalars = run_eval(cfg, tokenizer=tok, values_caption_json=str(capj))
+    for key in ("sim_mean", "sim_std", "sim_75pc", "sim_90pc", "sim_95pc",
+                "sim_gt_05pc", "bg_mean", "bg_std", "FID_val", "precision",
+                "recall", "gen_clipscore", "train_clipscore",
+                "corr_entropy_sim", "corr_jpegsize_sim", "corr_tv_sim"):
+        assert key in scalars, f"missing scalar {key}"
+        assert np.isfinite(scalars[key]) or key.startswith("corr"), key
+    out = tmp_path / "ret_plots"
+    assert (out / "similarity.npy").exists()
+    sim = np.load(out / "similarity.npy")
+    assert sim.shape == (8, 10)
+    assert (out / "histogram.png").exists()
+    assert list((out / "galleries").glob("gallery_rank*.png"))
+    assert (out / "fid_stats_values.npz").exists()
+    assert (out / "logs" / "metrics.jsonl").exists()
+
+
+def test_run_eval_splitloss_and_dup_pickle(eval_dirs, cpu_devices, tmp_path):
+    import pickle
+
+    tmp, gen, train, capj = eval_dirs
+    wpath = tmp_path / "weights.pickle"
+    with open(wpath, "wb") as f:
+        pickle.dump([5] * 3 + [1] * 7, f)
+    cfg = EvalConfig(
+        query_dir=str(gen), values_dir=str(train),
+        pt_style="sscd", arch="resnet50_disc", batch_size=4, image_size=32,
+        similarity_metric="splitloss", num_loss_chunks=2,
+        compute_fid=False, compute_clip_score=False, compute_complexity=False,
+        galleries=False, dup_weights_pickle=str(wpath),
+        output_dir=str(tmp_path / "ret2"))
+    scalars = run_eval(cfg, tokenizer=HashTokenizer(1000, 77))
+    assert "dupsim_mean" in scalars and "nondupsim_mean" in scalars
+    assert "sim_gt_05pc" in scalars
+
+
+def test_prompts_txt_found_in_parent_dir(tmp_path):
+    """Regression: the sampling pipeline writes prompts.txt NEXT TO
+    generations/ — eval must find it there."""
+    rng = np.random.default_rng(0)
+    gen = tmp_path / "run" / "generations"
+    gen.mkdir(parents=True)
+    for i in range(4):
+        Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8)).save(
+            gen / f"{i}.png")
+    (tmp_path / "run" / "prompts.txt").write_text("a\nb\n")
+    f = EvalImageFolder(gen, 16)
+    assert f.captions == ["a", "a", "b", "b"]
+
+
+def test_caption_json_path_alias_matching(tmp_path):
+    """Regression: caption tables written with relative paths must still match
+    absolute eval paths (basename fallback), with a warning on real misses."""
+    rng = np.random.default_rng(0)
+    d = tmp_path / "train" / "c0"
+    d.mkdir(parents=True)
+    for i in range(3):
+        Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8)).save(
+            d / f"im{i}.png")
+    # table keyed by basename-ish relative path from a different root
+    capj = tmp_path / "caps.json"
+    capj.write_text(json.dumps({f"./other/root/im{i}.png": [f"cap {i}"]
+                                for i in range(3)}))
+    f = EvalImageFolder(tmp_path / "train", 16, caption_json=capj)
+    assert f.captions == ["cap 0", "cap 1", "cap 2"]

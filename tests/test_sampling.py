@@ -1,0 +1,131 @@
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from dcr_tpu.core.config import MeshConfig, ModelConfig, SampleConfig
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.data.tokenizer import HashTokenizer
+from dcr_tpu.diffusion.trainer import build_models
+from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.sampling import prompts as P
+from dcr_tpu.sampling.sampler import make_sampler
+
+
+@pytest.fixture(scope="module")
+def tiny_models():
+    from dcr_tpu.core.config import TrainConfig
+
+    cfg = TrainConfig()
+    cfg.model = ModelConfig.tiny()
+    return build_models(cfg, jax.random.key(0))
+
+
+def _sample_cfg(**kw):
+    d = dict(resolution=16, num_inference_steps=4, guidance_scale=7.5,
+             sampler="ddim", im_batch=2, seed=0)
+    d.update(kw)
+    return SampleConfig(**d)
+
+
+def test_sampler_shapes_and_determinism(tiny_models, cpu_devices):
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    cfg = _sample_cfg()
+    sampler = make_sampler(cfg, models, mesh)
+    tok = HashTokenizer(models.text_encoder.config.text_vocab_size,
+                        models.text_encoder.config.text_max_length)
+    ids = np.repeat(tok(["a church", "a truck"]), 4, axis=0)  # [8, L]
+    unc = np.broadcast_to(tok([""])[0], ids.shape).copy()
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    imgs = np.asarray(sampler(p, ids, unc, rngmod.root_key(1)))
+    assert imgs.shape == (8, 16, 16, 3)
+    assert imgs.min() >= 0.0 and imgs.max() <= 1.0
+    assert np.isfinite(imgs).all()
+    imgs2 = np.asarray(sampler(p, ids, unc, rngmod.root_key(1)))
+    np.testing.assert_array_equal(imgs, imgs2)
+    imgs3 = np.asarray(sampler(p, ids, unc, rngmod.root_key(2)))
+    assert not np.array_equal(imgs, imgs3)
+
+
+@pytest.mark.parametrize("sampler_name", ["dpm++", "ddpm"])
+def test_other_samplers_run(tiny_models, cpu_devices, sampler_name):
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    cfg = _sample_cfg(sampler=sampler_name)
+    sampler = make_sampler(cfg, models, mesh)
+    tok = HashTokenizer(models.text_encoder.config.text_vocab_size,
+                        models.text_encoder.config.text_max_length)
+    ids = np.repeat(tok(["x"]), 8, axis=0)
+    unc = np.broadcast_to(tok([""])[0], ids.shape).copy()
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    imgs = np.asarray(sampler(p, ids, unc, rngmod.root_key(0)))
+    assert imgs.shape == (8, 16, 16, 3) and np.isfinite(imgs).all()
+
+
+def test_rand_noise_lam_changes_output(tiny_models, cpu_devices):
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    tok = HashTokenizer(models.text_encoder.config.text_vocab_size,
+                        models.text_encoder.config.text_max_length)
+    ids = np.repeat(tok(["x"]), 8, axis=0)
+    unc = np.broadcast_to(tok([""])[0], ids.shape).copy()
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    base = np.asarray(make_sampler(_sample_cfg(), models, mesh)(p, ids, unc,
+                                                                rngmod.root_key(1)))
+    noised = np.asarray(make_sampler(_sample_cfg(rand_noise_lam=0.5), models, mesh)(
+        p, ids, unc, rngmod.root_key(1)))
+    assert not np.array_equal(base, noised)
+
+
+def test_prompt_lists_all_styles(tmp_path):
+    tok = HashTokenizer(1000, 16)
+    assert P.build_prompt_list("nolevel", 3, seed=0, tokenizer=tok) == ["An image"] * 3
+    cl = P.build_prompt_list("classlevel", 5, seed=0, tokenizer=tok)
+    assert len(cl) == 5 and all(p.startswith("An image of ") for p in cl)
+    assert cl == P.build_prompt_list("classlevel", 5, seed=0, tokenizer=tok)
+    assert cl != P.build_prompt_list("classlevel", 5, seed=1, tokenizer=tok)
+
+    caps = {f"img{i}": [f"caption number {i}", "alt"] for i in range(10)}
+    j = tmp_path / "caps.json"
+    j.write_text(json.dumps(caps))
+    bl = P.build_prompt_list("instancelevel_blip", 4, seed=0, tokenizer=tok,
+                             caption_json=j)
+    assert len(bl) == 4 and all(p.startswith("caption number") for p in bl)
+
+    rnd_caps = {f"img{i}": [str([i + 1, i + 2, i + 3])] for i in range(5)}
+    j2 = tmp_path / "rnd.json"
+    j2.write_text(json.dumps(rnd_caps))
+    rl = P.build_prompt_list("instancelevel_random", 3, seed=0, tokenizer=tok,
+                             caption_json=j2)
+    assert all(len(p.split()) == 3 for p in rl)
+
+    with pytest.raises(ValueError):
+        P.build_prompt_list("instancelevel_blip", 2, seed=0, tokenizer=tok)
+
+
+def test_prompt_augmentations(tmp_path):
+    tok = HashTokenizer(1000, 16)
+    rng = np.random.default_rng(0)
+    base = "a photo of a church"
+    n = P.prompt_augmentation(base, "rand_numb_add", tokenizer=tok, rng=rng)
+    assert len(n.split()) == 7
+    assert sum(w.isdigit() for w in n.split()) == 2
+    w = P.prompt_augmentation(base, "rand_word_add", tokenizer=tok, rng=rng)
+    assert len(w.split()) == 7
+    r = P.prompt_augmentation(base, "rand_word_repeat", tokenizer=tok, rng=rng)
+    assert len(r.split()) == 7 and set(r.split()) == set(base.split())
+    with pytest.raises(ValueError):
+        P.prompt_augmentation(base, "bogus", tokenizer=tok, rng=rng)
+    # augs gate: only instancelevel_blip (reference diff_inference.py:241-242)
+    caps = {"a": ["c"]}
+    j = tmp_path / "c.json"
+    j.write_text(json.dumps(caps))
+    with pytest.raises(ValueError):
+        P.build_prompt_list("nolevel", 2, seed=0, tokenizer=tok, rand_augs="rand_word_add")
+
+
+def test_save_prompts(tmp_path):
+    path = P.save_prompts(["a", "b"], tmp_path / "out")
+    assert path.read_text() == "a\nb\n"

@@ -71,3 +71,23 @@ def test_trainer_resume(train_setup):
     assert trainer2.maybe_resume() == 6
     trainer2.train()
     assert 8 in trainer2.ckpt.all_steps()
+
+
+def test_ema_weights_are_exported(train_setup):
+    """Regression: with ema_decay>0 the exported unet must be the EMA weights."""
+    cfg, tmp_path = train_setup
+    cfg.ema_decay = 0.5
+    cfg.output_dir = str(tmp_path / "run_ema")
+    trainer = Trainer(cfg)
+    trainer.train()
+    import jax
+    import numpy as np
+
+    from dcr_tpu.core.checkpoint import import_hf_layout
+
+    exported = import_hf_layout(tmp_path / "run_ema" / "checkpoint", "unet")
+    ema_leaf = np.asarray(jax.tree.leaves(jax.device_get(trainer.state.ema_params))[0])
+    raw_leaf = np.asarray(jax.tree.leaves(jax.device_get(trainer.state.unet_params))[0])
+    exp_leaf = np.asarray(jax.tree.leaves(exported)[0])
+    np.testing.assert_array_equal(exp_leaf, ema_leaf)
+    assert not np.array_equal(exp_leaf, raw_leaf)
