@@ -25,6 +25,14 @@ def bench(batch_size: int, steps: int = 10):
     import jax
     import numpy as np
 
+    # persistent compile cache: the SD-2.1 train step is a large program; let
+    # repeated bench runs (and the driver's round-end run) reuse the executable
+    from pathlib import Path
+
+    cache_dir = Path(__file__).resolve().parent / ".jax_cache"
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
+
     from dcr_tpu.core.config import MeshConfig, ModelConfig, TrainConfig
     from dcr_tpu.core import rng as rngmod
     from dcr_tpu.diffusion import train as T

@@ -1,0 +1,26 @@
+"""L5: thin CLI entry points.
+
+    python -m dcr_tpu.cli.train    --data.train_data_dir=... [--key=value ...]
+    python -m dcr_tpu.cli.sample   --model_path=... --num_batches=...
+    python -m dcr_tpu.cli.evaluate --query_dir=... --values_dir=...
+    python -m dcr_tpu.cli.search   embed|search --...
+    python -m dcr_tpu.cli.mitigate --model_path=... [--rand_noise_lam=...]
+
+Each maps one reference script (diff_train.py, diff_inference.py,
+diff_retrieval.py, embedding_search/*, sd_mitigation.py) onto the library
+APIs; config parsing is the shared dotted-key system (core.config.parse_cli).
+
+Set DCR_TPU_PLATFORM=cpu to force a platform after jax import — needed in
+environments that pre-import jax with a pinned platform (env vars are then too
+late; jax.config still works as long as no backend has initialized).
+"""
+
+import os
+
+
+def setup_platform() -> None:
+    platform = os.environ.get("DCR_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)

@@ -1,0 +1,53 @@
+"""dcr-sample: bulk generation from a checkpoint (reference diff_inference.py).
+
+The conditioning style comes from the run's serialized config.json when
+present (replacing the reference's parse-the-path-substring heuristics,
+diff_inference.py:230-239); --modelstyle overrides explicitly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from pathlib import Path
+
+from dcr_tpu.core.config import SampleConfig, parse_cli
+from dcr_tpu.sampling.pipeline import generate
+
+
+def infer_modelstyle(model_path: str) -> str:
+    cfg_file = Path(model_path) / "config.json"
+    if cfg_file.exists():
+        try:
+            return json.loads(cfg_file.read_text())["data"]["class_prompt"]
+        except (KeyError, json.JSONDecodeError):
+            pass
+    return "nolevel"
+
+
+def main(argv=None) -> None:
+    from dcr_tpu.cli import setup_platform
+
+    setup_platform()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    modelstyle = None
+    caption_json = None
+    rest = []
+    for arg in argv:
+        if arg.startswith("--modelstyle="):
+            modelstyle = arg.split("=", 1)[1]
+        elif arg.startswith("--caption_json="):
+            caption_json = arg.split("=", 1)[1]
+        else:
+            rest.append(arg)
+    cfg = parse_cli(SampleConfig, rest)
+    modelstyle = modelstyle or infer_modelstyle(cfg.model_path)
+    out = generate(cfg, modelstyle=modelstyle, caption_json=caption_json)
+    logging.getLogger("dcr_tpu").info("generations -> %s", out)
+
+
+if __name__ == "__main__":
+    main()

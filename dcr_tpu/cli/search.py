@@ -1,0 +1,47 @@
+"""dcr-search: LAION pipeline (reference embedding_search/ scripts).
+
+Subcommands:
+    download  --parquet_path=... --laion_folder=...
+    embed     --gen_folder=<images-or-tars-dir> [--embedding_out=...]
+    search    --gen_folder=... --laion_folder=<dir-of-chunk-dirs> --out_path=...
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+from pathlib import Path
+
+from dcr_tpu.core.config import SearchConfig, parse_cli
+from dcr_tpu.search import embed as E
+from dcr_tpu.search import search as S
+
+
+def main(argv=None) -> None:
+    from dcr_tpu.cli import setup_platform
+
+    setup_platform()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0].startswith("--"):
+        raise SystemExit("usage: dcr-search {download|embed|search} --key=value ...")
+    command, rest = argv[0], argv[1:]
+    cfg = parse_cli(SearchConfig, rest)
+    if command == "download":
+        E.download_laion_chunk(cfg.parquet_path, cfg.laion_folder,
+                               image_size=cfg.image_size)
+        E.embed_images(cfg, source=cfg.laion_folder)
+        if cfg.delete_tars:
+            E.cleanup_tars(cfg.laion_folder)
+    elif command == "embed":
+        E.embed_images(cfg, source=cfg.gen_folder, out_path=cfg.embedding_out)
+    elif command == "search":
+        folders = sorted(p for p in Path(cfg.laion_folder).iterdir() if p.is_dir())
+        S.run_search(cfg, laion_folders=folders)
+    else:
+        raise SystemExit(f"unknown subcommand {command!r}")
+
+
+if __name__ == "__main__":
+    main()

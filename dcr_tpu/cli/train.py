@@ -1,0 +1,26 @@
+"""dcr-train: finetune the diffusion stack (reference diff_train.py CLI)."""
+
+from __future__ import annotations
+
+import logging
+
+from dcr_tpu.core.config import TrainConfig, parse_cli
+from dcr_tpu.diffusion.sample_hook import make_sample_hook
+from dcr_tpu.diffusion.trainer import Trainer
+
+
+def main(argv=None) -> None:
+    from dcr_tpu.cli import setup_platform
+
+    setup_platform()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    cfg = parse_cli(TrainConfig, argv)
+    # periodic sample grids every save_steps (the reference's visual check)
+    trainer = Trainer(cfg, sample_hook=make_sample_hook())
+    metrics = trainer.train()
+    logging.getLogger("dcr_tpu").info("training done: %s", metrics)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Periodic in-training sample grids — the reference's visual regression check.
+
+diff_train.py builds a DiffusionPipeline mid-training and writes an image grid
+per class every save_steps (571-611 initial grid for ≤3 classes, 669-701 the
+periodic regeneration, via the missing concat_h helper — SURVEY.md §2.4). Here
+the hook reuses the jitted scan sampler with the live train-state params (EMA
+when enabled) and writes <output_dir>/generations/step_<n>.png.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+
+import numpy as np
+from PIL import Image
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.config import SampleConfig
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.eval.gallery import image_grid
+from dcr_tpu.models.vae import vae_scale_factor
+from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.sampling.sampler import make_sampler
+
+log = logging.getLogger("dcr_tpu")
+
+
+def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 4,
+                     max_prompts: int = 3, guidance_scale: float = 7.5):
+    """Returns a hook(trainer, step) for Trainer(sample_hook=...).
+
+    Prompts: first `max_prompts` classes as "An image of {cls}" (classlevel),
+    else the instance prompt (reference samples ≤3 classes, diff_train.py:573).
+    """
+    state = {}  # memoized jitted sampler (compile once)
+
+    def hook(trainer, step: int) -> None:
+        cfg = trainer.cfg
+        if "sampler" not in state:
+            px = vae_scale_factor(cfg.model) * cfg.model.sample_size
+            scfg = SampleConfig(
+                resolution=px, num_inference_steps=num_inference_steps,
+                guidance_scale=guidance_scale, sampler="ddim", seed=cfg.seed)
+            state["sampler"] = make_sampler(scfg, trainer.models, trainer.mesh)
+            if cfg.data.class_prompt == "classlevel":
+                names = trainer.dataset.classnames[:max_prompts]
+                state["prompts"] = [f"An image of {c}" for c in names]
+            else:
+                state["prompts"] = [cfg.data.instance_prompt]
+            ids = trainer.tokenizer(state["prompts"])
+            ids = np.repeat(ids, images_per_prompt, axis=0)
+            # pad the batch to the mesh's data-parallel size for sharding
+            dp = pmesh.data_parallel_size(trainer.mesh)
+            state["real"] = len(ids)
+            pad = (-len(ids)) % dp
+            if pad:
+                ids = np.concatenate([ids, np.repeat(ids[-1:], pad, axis=0)])
+            state["ids"] = ids
+            state["uncond"] = np.broadcast_to(
+                trainer.tokenizer([""])[0], state["ids"].shape).copy()
+        params = {
+            "unet": (trainer.state.ema_params if trainer.state.ema_params
+                     is not None else trainer.state.unet_params),
+            "vae": trainer.state.vae_params,
+            "text": trainer.state.text_params,
+        }
+        key = rngmod.step_key(rngmod.stream_key(rngmod.root_key(cfg.seed),
+                                                "train_samples"), step)
+        images = pmesh.to_host(state["sampler"](params, state["ids"],
+                                                state["uncond"], key))[: state["real"]]
+        if dist.is_primary():
+            grid = image_grid(list(images), cols=images_per_prompt)
+            out = Path(cfg.output_dir) / "generations"
+            out.mkdir(parents=True, exist_ok=True)
+            grid.save(out / f"step_{step}.png")
+            log.info("sample grid -> %s", out / f"step_{step}.png")
+
+    return hook

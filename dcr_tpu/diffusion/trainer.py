@@ -66,6 +66,9 @@ class Trainer:
         if dist.is_primary():
             self.out_dir.mkdir(parents=True, exist_ok=True)
             save_config(cfg, self.out_dir / "config.json")
+            from dcr_tpu.utils.provenance import stamp
+
+            stamp(self.out_dir)
         self.tokenizer = tokenizer or load_tokenizer(
             cfg.pretrained_model or None,
             vocab_size=cfg.model.text_vocab_size,

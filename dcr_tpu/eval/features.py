@@ -27,6 +27,13 @@ from dcr_tpu.data.dataset import IMG_EXTENSIONS, _resize_shorter_side
 from dcr_tpu.parallel import mesh as pmesh
 
 
+# the reference's eval-transform stats: retrieval backbones see
+# Normalize([0.5],[0.5]) inputs (diff_retrieval.py:329); the LAION embedding
+# pipeline uses ImageNet stats (embedding_search/utils.py:35-40)
+HALF_NORM = ((0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
+IMAGENET_NORM = ((0.485, 0.456, 0.406), (0.229, 0.224, 0.225))
+
+
 def natsort_key(path: Path):
     """Natural sort (gen_0, gen_2, gen_10) — the reference depends on natsort
     ordering generations to align with prompts.txt lines."""
@@ -47,9 +54,17 @@ class EvalImageFolder:
 
     def __init__(self, root: str | Path, image_size: int = 224, *,
                  caption_json: Optional[str | Path] = None,
-                 normalize: Optional[tuple[Sequence[float], Sequence[float]]] = None):
+                 normalize: Optional[tuple[Sequence[float], Sequence[float]]] = None,
+                 resize_to: Optional[int] = None, crop: bool = True):
+        """resize_to: shorter-side resize before the center crop (the reference
+        eval transform is Resize(256) + CenterCrop(224), diff_retrieval.py:325);
+        defaults to image_size. crop=False squashes the whole image to
+        image_size² instead (the reference FID loader feeds uncropped images,
+        metrics/fid.py:60-73)."""
         self.root = Path(root)
         self.image_size = image_size
+        self.resize_to = resize_to or image_size
+        self.crop = crop
         self.normalize = normalize
         flat = sorted([p for p in self.root.iterdir()
                        if p.suffix.lower() in IMG_EXTENSIONS], key=natsort_key) \
@@ -108,10 +123,14 @@ class EvalImageFolder:
     def load(self, i: int) -> np.ndarray:
         with Image.open(self.paths[i]) as img:
             img = img.convert("RGB")
-            img = _resize_shorter_side(img, self.image_size)
-            w, h = img.size
-            left, top = (w - self.image_size) // 2, (h - self.image_size) // 2
-            img = img.crop((left, top, left + self.image_size, top + self.image_size))
+            if self.crop:
+                img = _resize_shorter_side(img, self.resize_to)
+                w, h = img.size
+                left, top = (w - self.image_size) // 2, (h - self.image_size) // 2
+                img = img.crop((left, top, left + self.image_size,
+                                top + self.image_size))
+            else:
+                img = img.resize((self.image_size, self.image_size), Image.BILINEAR)
             arr = np.asarray(img, np.float32) / 255.0
         if self.normalize is not None:
             mean, std = self.normalize

@@ -1,0 +1,73 @@
+"""Ranked-retrieval quality metrics: mAP, precision@k, recall@k, MRR.
+
+Capability-equivalent of the reference's retrieval scoring toolkit
+(utils_ret.py:300-417: score_ap / mAP / precision-recall helpers used for
+copy-detection benchmark evaluation). The reference's micro_average_precision
+is dead code that crashes on call (utils_ret.py:890-902, SURVEY.md §2.4) and is
+deliberately not reproduced.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def average_precision(ranked_relevant: Sequence[bool],
+                      num_relevant_total: int) -> float:
+    """AP for one query given relevance of its ranked results."""
+    if num_relevant_total == 0:
+        return float("nan")
+    rel = np.asarray(ranked_relevant, bool)
+    if not rel.any():
+        return 0.0
+    cum_rel = np.cumsum(rel)
+    precision_at = cum_rel / (np.arange(len(rel)) + 1)
+    return float(np.sum(precision_at * rel) / num_relevant_total)
+
+
+def mean_average_precision(sim: np.ndarray, relevance: np.ndarray) -> float:
+    """sim: [Q, N] scores; relevance: [Q, N] bool ground truth."""
+    ranks = np.argsort(-sim, axis=1)
+    aps = []
+    for q in range(sim.shape[0]):
+        rel_ranked = relevance[q][ranks[q]]
+        aps.append(average_precision(rel_ranked, int(relevance[q].sum())))
+    return float(np.nanmean(aps))
+
+
+def precision_at_k(sim: np.ndarray, relevance: np.ndarray, k: int) -> float:
+    ranks = np.argsort(-sim, axis=1)[:, :k]
+    rel = np.take_along_axis(relevance, ranks, axis=1)
+    return float(np.mean(rel.sum(axis=1) / k))
+
+
+def recall_at_k(sim: np.ndarray, relevance: np.ndarray, k: int) -> float:
+    ranks = np.argsort(-sim, axis=1)[:, :k]
+    rel = np.take_along_axis(relevance, ranks, axis=1)
+    total = relevance.sum(axis=1)
+    valid = total > 0
+    if not valid.any():
+        return float("nan")
+    return float(np.mean(rel.sum(axis=1)[valid] / total[valid]))
+
+
+def mean_reciprocal_rank(sim: np.ndarray, relevance: np.ndarray) -> float:
+    ranks = np.argsort(-sim, axis=1)
+    rr = []
+    for q in range(sim.shape[0]):
+        rel_ranked = relevance[q][ranks[q]]
+        hits = np.flatnonzero(rel_ranked)
+        rr.append(1.0 / (hits[0] + 1) if len(hits) else 0.0)
+    return float(np.mean(rr))
+
+
+def retrieval_report(sim: np.ndarray, relevance: np.ndarray,
+                     ks: Sequence[int] = (1, 5, 10)) -> dict:
+    out = {"mAP": mean_average_precision(sim, relevance),
+           "MRR": mean_reciprocal_rank(sim, relevance)}
+    for k in ks:
+        out[f"precision@{k}"] = precision_at_k(sim, relevance, k)
+        out[f"recall@{k}"] = recall_at_k(sim, relevance, k)
+    return out

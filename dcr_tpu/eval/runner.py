@@ -35,7 +35,12 @@ from dcr_tpu.eval import fid as FID
 from dcr_tpu.eval import gallery as G
 from dcr_tpu.eval import ipr as IPR
 from dcr_tpu.eval import similarity as SIM
-from dcr_tpu.eval.features import EvalImageFolder, extract_features, make_extractor
+from dcr_tpu.eval.features import (
+    HALF_NORM,
+    EvalImageFolder,
+    extract_features,
+    make_extractor,
+)
 from dcr_tpu.models.clip_image import CLIPImageTower, init_clip_scorer, make_clip_scorer
 from dcr_tpu.models.inception import InceptionV3FID
 from dcr_tpu.models.resnet import SSCDModel
@@ -77,27 +82,41 @@ def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
                          mesh, *, scorer_params=None, batch_size: int = 32,
                          clip_image_size: int = 224) -> float:
     """Mean CLIP cosine between each image and its caption
-    (reference gen_clipscore, utils_ret.py:1045-1066)."""
+    (reference gen_clipscore, utils_ret.py:1045-1066). Images are re-loaded raw
+    in [0,1]; CLIPImageTower applies CLIP's own normalization internally (the
+    reference feeds 0.5/0.5-normalized tensors to clip.encode_image — a known
+    quirk we deliberately correct)."""
     import jax.numpy as jnp
 
     if folder.captions is None:
         return float("nan")
+    raw = EvalImageFolder(folder.root, clip_image_size,
+                          resize_to=round(clip_image_size * 256 / 224))
     scorer = make_clip_scorer()
     if scorer_params is None:
         scorer_params = init_clip_scorer(jax.random.key(7), scorer, clip_image_size)
-    score_fn = jax.jit(lambda p, im, ids: scorer.score(p, im, ids))
+    batch_spec = pmesh.batch_sharding(mesh)
+
+    @jax.jit
+    def score_fn(p, im, ids):
+        im = jax.lax.with_sharding_constraint(im, batch_spec)
+        return scorer.score(p, im, ids)
+
     scores = []
     for start in range(0, len(folder), batch_size):
-        idx = range(start, min(start + batch_size, len(folder)))
-        images = np.stack([folder.load(i) for i in idx])
-        if images.shape[1] != clip_image_size:
-            images = np.asarray(jax.image.resize(
-                jnp.asarray(images),
-                (len(images), clip_image_size, clip_image_size, 3), "bilinear"))
+        idx = list(range(start, min(start + batch_size, len(folder))))
+        images = np.stack([raw.load(i) for i in idx])
         ids = tokenizer([folder.captions[i] for i in idx],
                         max_length=scorer.text_config.text_max_length)
-        out = score_fn(scorer_params, jnp.asarray(images), jnp.asarray(ids))
-        scores.extend(np.asarray(jax.device_get(out)).tolist())
+        real = len(idx)
+        dp = pmesh.data_parallel_size(mesh)
+        pad = (-real) % dp
+        if pad:
+            images = np.concatenate([images, np.repeat(images[-1:], pad, 0)])
+            ids = np.concatenate([ids, np.repeat(ids[-1:], pad, 0)])
+        out = pmesh.to_host(score_fn(scorer_params, jnp.asarray(images),
+                                     jnp.asarray(ids)))[:real]
+        scores.extend(out.tolist())
     return float(np.mean(scores))
 
 
@@ -116,10 +135,13 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
     writer = MetricWriter(out_dir / "logs")
     tokenizer = tokenizer or load_tokenizer(None)
 
-    query = EvalImageFolder(cfg.query_dir, cfg.image_size,
-                            caption_json=query_caption_json)
-    values = EvalImageFolder(cfg.values_dir, cfg.image_size,
-                             caption_json=values_caption_json)
+    # reference retrieval transform: Resize(256) + CenterCrop(224) +
+    # Normalize([0.5],[0.5]) (diff_retrieval.py:325-329), scaled to image_size
+    resize_to = round(cfg.image_size * 256 / 224)
+    query = EvalImageFolder(cfg.query_dir, cfg.image_size, resize_to=resize_to,
+                            normalize=HALF_NORM, caption_json=query_caption_json)
+    values = EvalImageFolder(cfg.values_dir, cfg.image_size, resize_to=resize_to,
+                             normalize=HALF_NORM, caption_json=values_caption_json)
     log.info("eval: %d query (gen) vs %d values (train)", len(query), len(values))
 
     apply_fn, params = build_backbone(cfg.pt_style, cfg.arch, jax.random.key(0),
@@ -140,6 +162,9 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
     scalars.update(SIM.background_stats(bg))
     if dist.is_primary():
         out_dir.mkdir(parents=True, exist_ok=True)
+        from dcr_tpu.utils.provenance import stamp
+
+        stamp(out_dir)
         np.save(out_dir / "similarity.npy", sim)
         G.histogram_plot(stats.top1, bg, out_dir / "histogram.png")
 
@@ -178,8 +203,9 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                 jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]
         fid_extract = make_extractor(
             lambda p, x: inception.apply({"params": p}, x), inception_params, mesh)
-        q_raw = EvalImageFolder(cfg.query_dir, 299)
-        v_raw = EvalImageFolder(cfg.values_dir, 299)
+        # reference FID feeds whole (uncropped) images; inception scales inputs
+        q_raw = EvalImageFolder(cfg.query_dir, 299, crop=False)
+        v_raw = EvalImageFolder(cfg.values_dir, 299, crop=False)
         q_act = extract_features(q_raw, fid_extract, batch_size=50)
         v_act = extract_features(v_raw, fid_extract, batch_size=50)
         scalars["FID_val"] = FID.fid_from_features(
@@ -192,8 +218,9 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                                   jnp.zeros((1, 224, 224, 3)))["params"]
         vgg_extract = make_extractor(
             lambda p, x: vgg.apply({"params": p}, x), vgg_params, mesh)
-        q224 = EvalImageFolder(cfg.query_dir, 224)
-        v224 = EvalImageFolder(cfg.values_dir, 224)
+        # VGG16Features normalizes internally (ImageNet stats) from [0,1]
+        q224 = EvalImageFolder(cfg.query_dir, 224, resize_to=256)
+        v224 = EvalImageFolder(cfg.values_dir, 224, resize_to=256)
         scalars.update(IPR.precision_recall(
             extract_features(v224, vgg_extract, batch_size=cfg.batch_size),
             extract_features(q224, vgg_extract, batch_size=cfg.batch_size)))

@@ -30,11 +30,11 @@ class CLIPLayer(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
         d = x.shape[-1]
-        h = nn.LayerNorm(dtype=self.dtype, name="ln1")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln1")(x)
         h = nn.MultiHeadDotProductAttention(num_heads=self.heads, dtype=self.dtype,
                                             deterministic=True, name="attn")(h, mask=mask)
         x = x + h
-        h = nn.LayerNorm(dtype=self.dtype, name="ln2")(x)
+        h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln2")(x)
         h = nn.Dense(4 * d, dtype=self.dtype, name="fc1")(h)
         # CLIP uses quick-gelu (x * sigmoid(1.702 x))
         h = h * nn.sigmoid(1.702 * h)
@@ -63,7 +63,7 @@ class CLIPTextModel(nn.Module):
                 penultimate = hidden
             hidden = CLIPLayer(cfg.text_heads, dtype=self.dtype,
                                name=f"layers_{i}")(hidden, causal)
-        ln_final = nn.LayerNorm(dtype=self.dtype, name="final_layer_norm")
+        ln_final = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_layer_norm")
         last = ln_final(hidden)
         penultimate = ln_final(penultimate)
         # pooled = embedding at the EOT token (highest token id = argmax trick,

@@ -1,0 +1,373 @@
+"""Torch→Flax weight converters for every pretrained backbone the framework
+consumes.
+
+The reference loads all weights from torch artifacts: diffusers SD checkpoints
+(diff_train.py:370-408), SSCD TorchScript archives (diff_retrieval.py:277-285),
+DINO hub checkpoints (dino_vits.py:340-487), pt_inception FID weights
+(metrics/inception.py:219-220), torchvision VGG16 (metrics/ipr.py:41), OpenAI
+CLIP. This module maps those state dicts onto our NHWC Flax parameter trees:
+
+    conv   [O,I,H,W] -> [H,W,I,O]
+    linear [O,I]     -> [I,O]
+    norm scale/bias and BN running stats copy through
+
+Converters take a plain ``{name: np.ndarray}`` state dict (call
+:func:`torch_state_dict_to_numpy` on a loaded torch module/TorchScript archive
+first, so torch is only required at conversion time, never at run time).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Mapping
+
+import numpy as np
+
+log = logging.getLogger("dcr_tpu")
+
+Arr = np.ndarray
+StateDict = Mapping[str, Arr]
+
+
+def torch_state_dict_to_numpy(module_or_sd) -> dict[str, Arr]:
+    """Accepts a torch nn.Module, a TorchScript module, or a state dict."""
+    sd = module_or_sd
+    if hasattr(sd, "state_dict"):
+        sd = sd.state_dict()
+    return {k: np.asarray(v.detach().cpu().numpy() if hasattr(v, "detach") else v)
+            for k, v in sd.items()}
+
+
+def conv_kernel(w: Arr) -> Arr:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def linear_kernel(w: Arr) -> Arr:
+    return np.transpose(w, (1, 0))
+
+
+def _set(tree: dict, path: str, value: Arr) -> None:
+    parts = path.split("/")
+    cur = tree
+    for p in parts[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[parts[-1]] = np.asarray(value)
+
+
+def _conv(tree: dict, dst: str, sd: StateDict, src: str) -> None:
+    _set(tree, f"{dst}/kernel", conv_kernel(sd[f"{src}.weight"]))
+    if f"{src}.bias" in sd:
+        _set(tree, f"{dst}/bias", sd[f"{src}.bias"])
+
+
+def _linear(tree: dict, dst: str, sd: StateDict, src: str) -> None:
+    _set(tree, f"{dst}/kernel", linear_kernel(sd[f"{src}.weight"]))
+    if f"{src}.bias" in sd:
+        _set(tree, f"{dst}/bias", sd[f"{src}.bias"])
+
+
+def _layernorm(tree: dict, dst: str, sd: StateDict, src: str) -> None:
+    _set(tree, f"{dst}/scale", sd[f"{src}.weight"])
+    _set(tree, f"{dst}/bias", sd[f"{src}.bias"])
+
+
+def _groupnorm(tree: dict, dst: str, sd: StateDict, src: str) -> None:
+    # our GroupNorm wrapper nests flax's GroupNorm as GroupNorm_0
+    _set(tree, f"{dst}/GroupNorm_0/scale", sd[f"{src}.weight"])
+    _set(tree, f"{dst}/GroupNorm_0/bias", sd[f"{src}.bias"])
+
+
+def _batchnorm(tree: dict, dst: str, sd: StateDict, src: str) -> None:
+    _set(tree, f"{dst}/scale", sd[f"{src}.weight"])
+    _set(tree, f"{dst}/bias", sd[f"{src}.bias"])
+    _set(tree, f"{dst}/mean", sd[f"{src}.running_mean"])
+    _set(tree, f"{dst}/var", sd[f"{src}.running_var"])
+
+
+# ---------------------------------------------------------------------------
+# ResNet-50 / SSCD (TorchScript archives, torchvision naming under `backbone.`)
+# ---------------------------------------------------------------------------
+
+def convert_resnet50(sd: StateDict, *, prefix: str = "",
+                     stage_sizes=(3, 4, 6, 3)) -> dict:
+    t: dict = {}
+    _conv(t, "conv1", sd, f"{prefix}conv1")
+    _batchnorm(t, "bn1", sd, f"{prefix}bn1")
+    for stage, blocks in enumerate(stage_sizes, start=1):
+        for b in range(blocks):
+            src = f"{prefix}layer{stage}.{b}"
+            dst = f"layer{stage}_{b}"
+            for c in (1, 2, 3):
+                _conv(t, f"{dst}/conv{c}", sd, f"{src}.conv{c}")
+                _batchnorm(t, f"{dst}/bn{c}", sd, f"{src}.bn{c}")
+            if f"{src}.downsample.0.weight" in sd:
+                _conv(t, f"{dst}/downsample_conv", sd, f"{src}.downsample.0")
+                _batchnorm(t, f"{dst}/downsample_bn", sd, f"{src}.downsample.1")
+    return t
+
+
+def convert_sscd(sd: StateDict) -> dict:
+    """SSCD TorchScript: resnet50 trunk under `backbone.`, projection under
+    `embeddings.` (a Linear). Returns params for models.resnet.SSCDModel."""
+    sd = dict(sd)
+    prefix = "backbone." if any(k.startswith("backbone.") for k in sd) else ""
+    out = {"backbone": convert_resnet50(sd, prefix=prefix)}
+    emb_key = next((k for k in sd if re.search(r"embeddings?\.(0\.)?weight$", k)
+                    and sd[k].ndim == 2), None)
+    if emb_key is None:
+        raise KeyError("no projection layer found in SSCD state dict")
+    bias_key = emb_key.replace("weight", "bias")
+    out["embeddings"] = {"kernel": linear_kernel(sd[emb_key])}
+    if bias_key in sd:
+        out["embeddings"]["bias"] = np.asarray(sd[bias_key])
+    else:
+        out["embeddings"]["bias"] = np.zeros(sd[emb_key].shape[0], np.float32)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# InceptionV3 FID (pt_inception-2015-12-05 naming == our module names)
+# ---------------------------------------------------------------------------
+
+def convert_inception_fid(sd: StateDict) -> dict:
+    t: dict = {}
+    convs = sorted({k[: -len(".conv.weight")] for k in sd
+                    if k.endswith(".conv.weight")})
+    for name in convs:
+        dst = name.replace(".", "/")
+        _conv(t, f"{dst}/conv", sd, f"{name}.conv")
+        _batchnorm(t, f"{dst}/bn", sd, f"{name}.bn")
+    if not t:
+        raise KeyError("no Inception conv blocks found in state dict")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# VGG16 (torchvision sequential naming)
+# ---------------------------------------------------------------------------
+
+def convert_vgg16(sd: StateDict) -> dict:
+    t: dict = {}
+    conv_indices = sorted(
+        {int(m.group(1)) for k in sd
+         if (m := re.match(r"features\.(\d+)\.weight", k))})
+    for i, idx in enumerate(conv_indices):
+        _conv(t, f"conv_{i}", sd, f"features.{idx}")
+    # fc1 consumes the flattened 7x7x512 feature map. torch flattens CHW
+    # (c*49 + h*7 + w) while our NHWC model flattens HWC (h*3584 + w*512 + c):
+    # reorder fc1's input columns accordingly before transposing.
+    w1 = sd["classifier.0.weight"]                       # [4096, 25088] (CHW cols)
+    w1 = w1.reshape(-1, 512, 7, 7).transpose(0, 2, 3, 1)  # -> [4096, 7, 7, 512]
+    _set(t, "fc1/kernel", linear_kernel(w1.reshape(-1, 7 * 7 * 512)))
+    _set(t, "fc1/bias", sd["classifier.0.bias"])
+    _linear(t, "fc2", sd, "classifier.3")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# DINO ViT (facebookresearch/dino naming)
+# ---------------------------------------------------------------------------
+
+def convert_dino_vit(sd: StateDict, depth: int = 12) -> dict:
+    t: dict = {}
+    _set(t, "cls_token", sd["cls_token"].reshape(1, 1, -1))
+    _set(t, "pos_embed", sd["pos_embed"])
+    _conv(t, "patch_embed/proj", sd, "patch_embed.proj")
+    for i in range(depth):
+        src = f"blocks.{i}"
+        dst = f"blocks_{i}"
+        _layernorm(t, f"{dst}/norm1", sd, f"{src}.norm1")
+        _linear(t, f"{dst}/qkv", sd, f"{src}.attn.qkv")
+        _linear(t, f"{dst}/proj", sd, f"{src}.attn.proj")
+        _layernorm(t, f"{dst}/norm2", sd, f"{src}.norm2")
+        _linear(t, f"{dst}/fc1", sd, f"{src}.mlp.fc1")
+        _linear(t, f"{dst}/fc2", sd, f"{src}.mlp.fc2")
+    _layernorm(t, "norm", sd, "norm")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# HF CLIPTextModel (transformers naming) -> models.clip_text.CLIPTextModel
+# ---------------------------------------------------------------------------
+
+def convert_clip_text(sd: StateDict, *, layers: int, heads: int) -> dict:
+    p = "text_model." if any(k.startswith("text_model.") for k in sd) else ""
+    t: dict = {}
+    emb = sd[f"{p}embeddings.token_embedding.weight"]
+    _set(t, "token_embedding/embedding", emb)
+    _set(t, "position_embedding", sd[f"{p}embeddings.position_embedding.weight"])
+    d = emb.shape[1]
+    head_dim = d // heads
+    for i in range(layers):
+        src = f"{p}encoder.layers.{i}"
+        dst = f"layers_{i}"
+        _layernorm(t, f"{dst}/ln1", sd, f"{src}.layer_norm1")
+        _layernorm(t, f"{dst}/ln2", sd, f"{src}.layer_norm2")
+        # flax MultiHeadDotProductAttention: query/key/value kernels
+        # [D, H, head_dim], out kernel [H, head_dim, D]
+        for torch_name, flax_name in (("q_proj", "query"), ("k_proj", "key"),
+                                      ("v_proj", "value")):
+            w = linear_kernel(sd[f"{src}.self_attn.{torch_name}.weight"])
+            b = sd[f"{src}.self_attn.{torch_name}.bias"]
+            _set(t, f"{dst}/attn/{flax_name}/kernel", w.reshape(d, heads, head_dim))
+            _set(t, f"{dst}/attn/{flax_name}/bias", b.reshape(heads, head_dim))
+        wo = sd[f"{src}.self_attn.out_proj.weight"]  # [D, D] = [out, in]
+        _set(t, f"{dst}/attn/out/kernel",
+             linear_kernel(wo).reshape(heads, head_dim, d))
+        _set(t, f"{dst}/attn/out/bias", sd[f"{src}.self_attn.out_proj.bias"])
+        _linear(t, f"{dst}/fc1", sd, f"{src}.mlp.fc1")
+        _linear(t, f"{dst}/fc2", sd, f"{src}.mlp.fc2")
+    _layernorm(t, "final_layer_norm", sd, f"{p}final_layer_norm")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# diffusers UNet2DConditionModel -> models.unet2d.UNet2DCondition
+# ---------------------------------------------------------------------------
+
+def _resnet_block(t: dict, dst: str, sd: StateDict, src: str) -> None:
+    _groupnorm(t, f"{dst}/norm1", sd, f"{src}.norm1")
+    _conv(t, f"{dst}/conv1", sd, f"{src}.conv1")
+    if f"{src}.time_emb_proj.weight" in sd:
+        _linear(t, f"{dst}/time_emb_proj", sd, f"{src}.time_emb_proj")
+    _groupnorm(t, f"{dst}/norm2", sd, f"{src}.norm2")
+    _conv(t, f"{dst}/conv2", sd, f"{src}.conv2")
+    if f"{src}.conv_shortcut.weight" in sd:
+        _conv(t, f"{dst}/conv_shortcut", sd, f"{src}.conv_shortcut")
+
+
+def _transformer2d(t: dict, dst: str, sd: StateDict, src: str,
+                   num_layers: int) -> None:
+    _groupnorm(t, f"{dst}/norm", sd, f"{src}.norm")
+    _linear(t, f"{dst}/proj_in", sd, f"{src}.proj_in")
+    _linear(t, f"{dst}/proj_out", sd, f"{src}.proj_out")
+    for k in range(num_layers):
+        bsrc = f"{src}.transformer_blocks.{k}"
+        bdst = f"{dst}/blocks_{k}"
+        for attn in ("attn1", "attn2"):
+            for qkv in ("to_q", "to_k", "to_v"):
+                _linear(t, f"{bdst}/{attn}/{qkv}", sd, f"{bsrc}.{attn}.{qkv}")
+            _linear(t, f"{bdst}/{attn}/to_out", sd, f"{bsrc}.{attn}.to_out.0")
+        _linear(t, f"{bdst}/ff/proj_in", sd, f"{bsrc}.ff.net.0.proj")
+        _linear(t, f"{bdst}/ff/proj_out", sd, f"{bsrc}.ff.net.2")
+        for n in ("norm1", "norm2", "norm3"):
+            _layernorm(t, f"{bdst}/{n}", sd, f"{bsrc}.{n}")
+
+
+def convert_unet(sd: StateDict, *, block_out_channels=(320, 640, 1280, 1280),
+                 layers_per_block: int = 2, transformer_layers: int = 1) -> dict:
+    t: dict = {}
+    n = len(block_out_channels)
+    _conv(t, "conv_in", sd, "conv_in")
+    _linear(t, "time_embedding/linear_1", sd, "time_embedding.linear_1")
+    _linear(t, "time_embedding/linear_2", sd, "time_embedding.linear_2")
+    for i in range(n):
+        has_attn = i < n - 1
+        for j in range(layers_per_block):
+            _resnet_block(t, f"down_{i}_res_{j}", sd,
+                          f"down_blocks.{i}.resnets.{j}")
+            if has_attn:
+                _transformer2d(t, f"down_{i}_attn_{j}", sd,
+                               f"down_blocks.{i}.attentions.{j}",
+                               transformer_layers)
+        if f"down_blocks.{i}.downsamplers.0.conv.weight" in sd:
+            _conv(t, f"down_{i}_downsample/conv", sd,
+                  f"down_blocks.{i}.downsamplers.0.conv")
+    _resnet_block(t, "mid_res_0", sd, "mid_block.resnets.0")
+    _resnet_block(t, "mid_res_1", sd, "mid_block.resnets.1")
+    _transformer2d(t, "mid_attn", sd, "mid_block.attentions.0",
+                   transformer_layers)
+    for i in range(n):  # diffusers up_blocks.i processes bottom-up
+        block_idx = n - 1 - i
+        has_attn = i > 0
+        for j in range(layers_per_block + 1):
+            _resnet_block(t, f"up_{block_idx}_res_{j}", sd,
+                          f"up_blocks.{i}.resnets.{j}")
+            if has_attn:
+                _transformer2d(t, f"up_{block_idx}_attn_{j}", sd,
+                               f"up_blocks.{i}.attentions.{j}",
+                               transformer_layers)
+        if f"up_blocks.{i}.upsamplers.0.conv.weight" in sd:
+            _conv(t, f"up_{block_idx}_upsample/conv", sd,
+                  f"up_blocks.{i}.upsamplers.0.conv")
+    _groupnorm(t, "conv_norm_out", sd, "conv_norm_out")
+    _conv(t, "conv_out", sd, "conv_out")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# diffusers AutoencoderKL -> models.vae.AutoencoderKL
+# ---------------------------------------------------------------------------
+
+def _vae_attn(t: dict, dst: str, sd: StateDict, src: str) -> None:
+    _groupnorm(t, f"{dst}/group_norm", sd, f"{src}.group_norm")
+    for name in ("to_q", "to_k", "to_v"):
+        _linear(t, f"{dst}/{name}", sd, f"{src}.{name}")
+    _linear(t, f"{dst}/to_out", sd, f"{src}.to_out.0")
+
+
+def convert_vae(sd: StateDict, *, block_out_channels=(128, 256, 512, 512),
+                layers_per_block: int = 2) -> dict:
+    t: dict = {}
+    n = len(block_out_channels)
+    enc, dec = "encoder", "decoder"
+    _conv(t, f"{enc}/conv_in", sd, "encoder.conv_in")
+    for i in range(n):
+        for j in range(layers_per_block):
+            _resnet_block(t, f"{enc}/down_{i}_res_{j}", sd,
+                          f"encoder.down_blocks.{i}.resnets.{j}")
+        if f"encoder.down_blocks.{i}.downsamplers.0.conv.weight" in sd:
+            _conv(t, f"{enc}/down_{i}_downsample/conv", sd,
+                  f"encoder.down_blocks.{i}.downsamplers.0.conv")
+    _resnet_block(t, f"{enc}/mid_res_0", sd, "encoder.mid_block.resnets.0")
+    _resnet_block(t, f"{enc}/mid_res_1", sd, "encoder.mid_block.resnets.1")
+    _vae_attn(t, f"{enc}/mid_attn", sd, "encoder.mid_block.attentions.0")
+    _groupnorm(t, f"{enc}/conv_norm_out", sd, "encoder.conv_norm_out")
+    _conv(t, f"{enc}/conv_out", sd, "encoder.conv_out")
+    _conv(t, f"{enc}/quant_conv", sd, "quant_conv")
+    _conv(t, f"{dec}/post_quant_conv", sd, "post_quant_conv")
+    _conv(t, f"{dec}/conv_in", sd, "decoder.conv_in")
+    _resnet_block(t, f"{dec}/mid_res_0", sd, "decoder.mid_block.resnets.0")
+    _resnet_block(t, f"{dec}/mid_res_1", sd, "decoder.mid_block.resnets.1")
+    _vae_attn(t, f"{dec}/mid_attn", sd, "decoder.mid_block.attentions.0")
+    for i in range(n):
+        for j in range(layers_per_block + 1):
+            _resnet_block(t, f"{dec}/up_{i}_res_{j}", sd,
+                          f"decoder.up_blocks.{i}.resnets.{j}")
+        if f"decoder.up_blocks.{i}.upsamplers.0.conv.weight" in sd:
+            _conv(t, f"{dec}/up_{i}_upsample/conv", sd,
+                  f"decoder.up_blocks.{i}.upsamplers.0.conv")
+    _groupnorm(t, f"{dec}/conv_norm_out", sd, "decoder.conv_norm_out")
+    _conv(t, f"{dec}/conv_out", sd, "decoder.conv_out")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# validation
+# ---------------------------------------------------------------------------
+
+def check_converted(params_expected, params_converted, *, path: str = "") -> list[str]:
+    """Structural diff: (path, why) strings for every mismatch — run after any
+    conversion; empty list = tree and shapes line up exactly."""
+    problems: list[str] = []
+    exp_is_dict = isinstance(params_expected, dict)
+    conv_is_dict = isinstance(params_converted, dict)
+    if exp_is_dict != conv_is_dict:
+        return [f"{path}: dict/leaf mismatch"]
+    if exp_is_dict:
+        for k in sorted(set(params_expected) | set(params_converted)):
+            if k not in params_expected:
+                problems.append(f"{path}/{k}: unexpected in converted")
+            elif k not in params_converted:
+                problems.append(f"{path}/{k}: missing from converted")
+            else:
+                problems += check_converted(params_expected[k],
+                                            params_converted[k],
+                                            path=f"{path}/{k}")
+        return problems
+    exp_shape = tuple(np.shape(params_expected))
+    conv_shape = tuple(np.shape(params_converted))
+    if exp_shape != conv_shape:
+        problems.append(f"{path}: shape {conv_shape} != expected {exp_shape}")
+    return problems

@@ -51,14 +51,14 @@ class InceptionA(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        b1 = ConvBN(64, (1, 1), name="branch1x1")(x)
-        b5 = ConvBN(48, (1, 1), name="branch5x5_1")(x)
-        b5 = ConvBN(64, (5, 5), padding=((2, 2), (2, 2)), name="branch5x5_2")(b5)
-        b3 = ConvBN(64, (1, 1), name="branch3x3dbl_1")(x)
-        b3 = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(b3)
-        b3 = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_3")(b3)
+        b1 = ConvBN(64, (1, 1), dtype=self.dtype, name="branch1x1")(x)
+        b5 = ConvBN(48, (1, 1), dtype=self.dtype, name="branch5x5_1")(x)
+        b5 = ConvBN(64, (5, 5), padding=((2, 2), (2, 2)), dtype=self.dtype, name="branch5x5_2")(b5)
+        b3 = ConvBN(64, (1, 1), dtype=self.dtype, name="branch3x3dbl_1")(x)
+        b3 = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="branch3x3dbl_2")(b3)
+        b3 = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="branch3x3dbl_3")(b3)
         bp = _avg_pool_exclude_pad(x)
-        bp = ConvBN(self.pool_features, (1, 1), name="branch_pool")(bp)
+        bp = ConvBN(self.pool_features, (1, 1), dtype=self.dtype, name="branch_pool")(bp)
         return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
 
@@ -67,10 +67,10 @@ class InceptionB(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        b3 = ConvBN(384, (3, 3), strides=(2, 2), name="branch3x3")(x)
-        bd = ConvBN(64, (1, 1), name="branch3x3dbl_1")(x)
-        bd = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
-        bd = ConvBN(96, (3, 3), strides=(2, 2), name="branch3x3dbl_3")(bd)
+        b3 = ConvBN(384, (3, 3), strides=(2, 2), dtype=self.dtype, name="branch3x3")(x)
+        bd = ConvBN(64, (1, 1), dtype=self.dtype, name="branch3x3dbl_1")(x)
+        bd = ConvBN(96, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="branch3x3dbl_2")(bd)
+        bd = ConvBN(96, (3, 3), strides=(2, 2), dtype=self.dtype, name="branch3x3dbl_3")(bd)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, bd, bp], axis=-1)
 
@@ -82,17 +82,17 @@ class InceptionC(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         c7 = self.c7
-        b1 = ConvBN(192, (1, 1), name="branch1x1")(x)
-        b7 = ConvBN(c7, (1, 1), name="branch7x7_1")(x)
-        b7 = ConvBN(c7, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7_2")(b7)
-        b7 = ConvBN(192, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7_3")(b7)
-        bd = ConvBN(c7, (1, 1), name="branch7x7dbl_1")(x)
-        bd = ConvBN(c7, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7dbl_2")(bd)
-        bd = ConvBN(c7, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7dbl_3")(bd)
-        bd = ConvBN(c7, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7dbl_4")(bd)
-        bd = ConvBN(192, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7dbl_5")(bd)
+        b1 = ConvBN(192, (1, 1), dtype=self.dtype, name="branch1x1")(x)
+        b7 = ConvBN(c7, (1, 1), dtype=self.dtype, name="branch7x7_1")(x)
+        b7 = ConvBN(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, name="branch7x7_2")(b7)
+        b7 = ConvBN(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, name="branch7x7_3")(b7)
+        bd = ConvBN(c7, (1, 1), dtype=self.dtype, name="branch7x7dbl_1")(x)
+        bd = ConvBN(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, name="branch7x7dbl_2")(bd)
+        bd = ConvBN(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, name="branch7x7dbl_3")(bd)
+        bd = ConvBN(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, name="branch7x7dbl_4")(bd)
+        bd = ConvBN(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, name="branch7x7dbl_5")(bd)
         bp = _avg_pool_exclude_pad(x)
-        bp = ConvBN(192, (1, 1), name="branch_pool")(bp)
+        bp = ConvBN(192, (1, 1), dtype=self.dtype, name="branch_pool")(bp)
         return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
 
@@ -101,12 +101,12 @@ class InceptionD(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        b3 = ConvBN(192, (1, 1), name="branch3x3_1")(x)
-        b3 = ConvBN(320, (3, 3), strides=(2, 2), name="branch3x3_2")(b3)
-        b7 = ConvBN(192, (1, 1), name="branch7x7x3_1")(x)
-        b7 = ConvBN(192, (1, 7), padding=((0, 0), (3, 3)), name="branch7x7x3_2")(b7)
-        b7 = ConvBN(192, (7, 1), padding=((3, 3), (0, 0)), name="branch7x7x3_3")(b7)
-        b7 = ConvBN(192, (3, 3), strides=(2, 2), name="branch7x7x3_4")(b7)
+        b3 = ConvBN(192, (1, 1), dtype=self.dtype, name="branch3x3_1")(x)
+        b3 = ConvBN(320, (3, 3), strides=(2, 2), dtype=self.dtype, name="branch3x3_2")(b3)
+        b7 = ConvBN(192, (1, 1), dtype=self.dtype, name="branch7x7x3_1")(x)
+        b7 = ConvBN(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, name="branch7x7x3_2")(b7)
+        b7 = ConvBN(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, name="branch7x7x3_3")(b7)
+        b7 = ConvBN(192, (3, 3), strides=(2, 2), dtype=self.dtype, name="branch7x7x3_4")(b7)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, b7, bp], axis=-1)
 
@@ -117,21 +117,21 @@ class InceptionE(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        b1 = ConvBN(320, (1, 1), name="branch1x1")(x)
-        b3 = ConvBN(384, (1, 1), name="branch3x3_1")(x)
-        b3a = ConvBN(384, (1, 3), padding=((0, 0), (1, 1)), name="branch3x3_2a")(b3)
-        b3b = ConvBN(384, (3, 1), padding=((1, 1), (0, 0)), name="branch3x3_2b")(b3)
+        b1 = ConvBN(320, (1, 1), dtype=self.dtype, name="branch1x1")(x)
+        b3 = ConvBN(384, (1, 1), dtype=self.dtype, name="branch3x3_1")(x)
+        b3a = ConvBN(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype, name="branch3x3_2a")(b3)
+        b3b = ConvBN(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype, name="branch3x3_2b")(b3)
         b3 = jnp.concatenate([b3a, b3b], axis=-1)
-        bd = ConvBN(448, (1, 1), name="branch3x3dbl_1")(x)
-        bd = ConvBN(384, (3, 3), padding=((1, 1), (1, 1)), name="branch3x3dbl_2")(bd)
-        bda = ConvBN(384, (1, 3), padding=((0, 0), (1, 1)), name="branch3x3dbl_3a")(bd)
-        bdb = ConvBN(384, (3, 1), padding=((1, 1), (0, 0)), name="branch3x3dbl_3b")(bd)
+        bd = ConvBN(448, (1, 1), dtype=self.dtype, name="branch3x3dbl_1")(x)
+        bd = ConvBN(384, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="branch3x3dbl_2")(bd)
+        bda = ConvBN(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype, name="branch3x3dbl_3a")(bd)
+        bdb = ConvBN(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype, name="branch3x3dbl_3b")(bd)
         bd = jnp.concatenate([bda, bdb], axis=-1)
         if self.pool_mode == "max":
             bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding=((1, 1), (1, 1)))
         else:
             bp = _avg_pool_exclude_pad(x)
-        bp = ConvBN(192, (1, 1), name="branch_pool")(bp)
+        bp = ConvBN(192, (1, 1), dtype=self.dtype, name="branch_pool")(bp)
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
@@ -149,24 +149,24 @@ class InceptionV3FID(nn.Module):
             x = jax.image.resize(x, (x.shape[0], 299, 299, 3), method="bilinear")
         if self.normalize_input:
             x = x * 2.0 - 1.0
-        x = ConvBN(32, (3, 3), strides=(2, 2), name="Conv2d_1a_3x3")(x)
-        x = ConvBN(32, (3, 3), name="Conv2d_2a_3x3")(x)
-        x = ConvBN(64, (3, 3), padding=((1, 1), (1, 1)), name="Conv2d_2b_3x3")(x)
+        x = ConvBN(32, (3, 3), strides=(2, 2), dtype=self.dtype, name="Conv2d_1a_3x3")(x)
+        x = ConvBN(32, (3, 3), dtype=self.dtype, name="Conv2d_2a_3x3")(x)
+        x = ConvBN(64, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, name="Conv2d_2b_3x3")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = ConvBN(80, (1, 1), name="Conv2d_3b_1x1")(x)
-        x = ConvBN(192, (3, 3), name="Conv2d_4a_3x3")(x)
+        x = ConvBN(80, (1, 1), dtype=self.dtype, name="Conv2d_3b_1x1")(x)
+        x = ConvBN(192, (3, 3), dtype=self.dtype, name="Conv2d_4a_3x3")(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = InceptionA(32, name="Mixed_5b")(x)
-        x = InceptionA(64, name="Mixed_5c")(x)
-        x = InceptionA(64, name="Mixed_5d")(x)
-        x = InceptionB(name="Mixed_6a")(x)
-        x = InceptionC(128, name="Mixed_6b")(x)
-        x = InceptionC(160, name="Mixed_6c")(x)
-        x = InceptionC(160, name="Mixed_6d")(x)
-        x = InceptionC(192, name="Mixed_6e")(x)
-        x = InceptionD(name="Mixed_7a")(x)
-        x = InceptionE("avg", name="Mixed_7b")(x)
-        x = InceptionE("max", name="Mixed_7c")(x)
+        x = InceptionA(32, dtype=self.dtype, name="Mixed_5b")(x)
+        x = InceptionA(64, dtype=self.dtype, name="Mixed_5c")(x)
+        x = InceptionA(64, dtype=self.dtype, name="Mixed_5d")(x)
+        x = InceptionB(dtype=self.dtype, name="Mixed_6a")(x)
+        x = InceptionC(128, dtype=self.dtype, name="Mixed_6b")(x)
+        x = InceptionC(160, dtype=self.dtype, name="Mixed_6c")(x)
+        x = InceptionC(160, dtype=self.dtype, name="Mixed_6d")(x)
+        x = InceptionC(192, dtype=self.dtype, name="Mixed_6e")(x)
+        x = InceptionD(dtype=self.dtype, name="Mixed_7a")(x)
+        x = InceptionE("avg", dtype=self.dtype, name="Mixed_7b")(x)
+        x = InceptionE("max", dtype=self.dtype, name="Mixed_7c")(x)
         return jnp.mean(x, axis=(1, 2))  # adaptive avg pool -> [B, 2048]
 
 

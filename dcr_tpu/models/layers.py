@@ -67,6 +67,7 @@ class ResnetBlock2D(nn.Module):
 
     out_channels: int
     num_groups: int = 32
+    epsilon: float = 1e-5
     dropout: float = 0.0
     dtype: jnp.dtype = jnp.float32
 
@@ -74,7 +75,7 @@ class ResnetBlock2D(nn.Module):
     def __call__(self, x: jax.Array, temb: Optional[jax.Array] = None,
                  deterministic: bool = True) -> jax.Array:
         residual = x
-        h = GroupNorm(self.num_groups, name="norm1")(x)
+        h = GroupNorm(self.num_groups, self.epsilon, name="norm1")(x)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=((1, 1), (1, 1)),
                     dtype=self.dtype, name="conv1")(h)
@@ -82,7 +83,7 @@ class ResnetBlock2D(nn.Module):
             temb_proj = nn.Dense(self.out_channels, dtype=self.dtype,
                                  name="time_emb_proj")(nn.silu(temb))
             h = h + temb_proj[:, None, None, :]
-        h = GroupNorm(self.num_groups, name="norm2")(h)
+        h = GroupNorm(self.num_groups, self.epsilon, name="norm2")(h)
         h = nn.silu(h)
         if self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=deterministic)(h)
@@ -149,12 +150,12 @@ class BasicTransformerBlock(nn.Module):
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         attn = CrossAttention(self.num_heads, self.head_dim, self.dim,
                               use_flash=self.use_flash, dtype=self.dtype, name="attn1")
-        x = x + attn(nn.LayerNorm(dtype=self.dtype, name="norm1")(x))
+        x = x + attn(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x))
         xattn = CrossAttention(self.num_heads, self.head_dim, self.dim,
                                use_flash=self.use_flash, dtype=self.dtype, name="attn2")
-        x = x + xattn(nn.LayerNorm(dtype=self.dtype, name="norm2")(x), context)
+        x = x + xattn(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm2")(x), context)
         ff = FeedForward(self.dim, dtype=self.dtype, name="ff")
-        x = x + ff(nn.LayerNorm(dtype=self.dtype, name="norm3")(x))
+        x = x + ff(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm3")(x))
         return x
 
 
@@ -211,13 +212,14 @@ class AttentionBlock2D(nn.Module):
 
     num_heads: int = 1
     num_groups: int = 32
+    epsilon: float = 1e-6
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         b, h, w, c = x.shape
         residual = x
-        out = GroupNorm(self.num_groups, name="group_norm")(x).reshape(b, h * w, c)
+        out = GroupNorm(self.num_groups, self.epsilon, name="group_norm")(x).reshape(b, h * w, c)
         head_dim = c // self.num_heads
         q = nn.Dense(c, dtype=self.dtype, name="to_q")(out)
         k = nn.Dense(c, dtype=self.dtype, name="to_k")(out)

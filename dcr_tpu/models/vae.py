@@ -43,15 +43,15 @@ class Encoder(nn.Module):
                     name="conv_in")(x.astype(self.dtype))
         for i, ch in enumerate(block_out):
             for j in range(cfg.vae_layers_per_block):
-                h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype,
+                h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype,
                                     name=f"down_{i}_res_{j}")(h)
             if i < len(block_out) - 1:
                 h = L.Downsample2D(ch, dtype=self.dtype, name=f"down_{i}_downsample")(h)
         ch = block_out[-1]
-        h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype, name="mid_res_0")(h)
+        h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype, name="mid_res_0")(h)
         h = L.AttentionBlock2D(num_groups=groups, dtype=self.dtype, name="mid_attn")(h)
-        h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype, name="mid_res_1")(h)
-        h = L.GroupNorm(groups, name="conv_norm_out")(h)
+        h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype, name="mid_res_1")(h)
+        h = L.GroupNorm(groups, epsilon=1e-6, name="conv_norm_out")(h)
         h = nn.silu(h)
         h = nn.Conv(2 * cfg.vae_latent_channels, (3, 3), padding=((1, 1), (1, 1)),
                     dtype=self.dtype, name="conv_out")(h)
@@ -75,16 +75,16 @@ class Decoder(nn.Module):
         ch = block_out[-1]
         h = nn.Conv(ch, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
                     name="conv_in")(z)
-        h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype, name="mid_res_0")(h)
+        h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype, name="mid_res_0")(h)
         h = L.AttentionBlock2D(num_groups=groups, dtype=self.dtype, name="mid_attn")(h)
-        h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype, name="mid_res_1")(h)
+        h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype, name="mid_res_1")(h)
         for i, ch in enumerate(reversed(block_out)):
             for j in range(cfg.vae_layers_per_block + 1):
-                h = L.ResnetBlock2D(ch, num_groups=groups, dtype=self.dtype,
+                h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype,
                                     name=f"up_{i}_res_{j}")(h)
             if i < len(block_out) - 1:
                 h = L.Upsample2D(ch, dtype=self.dtype, name=f"up_{i}_upsample")(h)
-        h = L.GroupNorm(groups, name="conv_norm_out")(h)
+        h = L.GroupNorm(groups, epsilon=1e-6, name="conv_norm_out")(h)
         h = nn.silu(h)
         h = nn.Conv(3, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype,
                     name="conv_out")(h)

@@ -9,12 +9,29 @@
 //
 // Build: see build.py (g++ -O2 -shared -fPIC jpeg_size.cc -ljpeg).
 
+#include <csetjmp>
 #include <cstddef>
 #include <cstdio>  // jpeglib.h needs FILE declared before inclusion
 #include <cstdlib>
 #include <cstring>
 
 #include <jpeglib.h>
+
+namespace {
+
+// libjpeg's default error_exit calls exit(), which would take down the host
+// Python process; longjmp back instead so the wrapper returns -1.
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(mgr->jump, 1);
+}
+
+}  // namespace
 
 extern "C" {
 
@@ -25,12 +42,20 @@ long jpeg_encoded_size(const unsigned char* data, int height, int width,
   if (data == nullptr || height <= 0 || width <= 0) return -1;
 
   jpeg_compress_struct cinfo;
-  jpeg_error_mgr jerr;
-  cinfo.err = jpeg_std_error(&jerr);
-  jpeg_create_compress(&cinfo);
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
 
   unsigned char* buffer = nullptr;
   unsigned long buffer_size = 0;
+
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_compress(&cinfo);
+    std::free(buffer);
+    return -1;
+  }
+
+  jpeg_create_compress(&cinfo);
   jpeg_mem_dest(&cinfo, &buffer, &buffer_size);
 
   cinfo.image_width = static_cast<JDIMENSION>(width);

@@ -50,22 +50,26 @@ def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    # keep q/k/v in their native dtype (bf16 hits the MXU at full rate);
+    # logits, softmax statistics, and the accumulator are f32
+    q = q_ref[0]                                      # [bq, D]
     sk = k_ref.shape[1]
     bq, d = q.shape
+    in_dtype = q.dtype
 
     def body(kb, carry):
         m, l, acc = carry
-        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
-        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :].astype(jnp.float32)
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)  # [bq, bk]
+                                preferred_element_type=jnp.float32) * scale
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            p.astype(in_dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)

@@ -1,0 +1,2 @@
+"""L4d: LAION-scale embedding pipeline — download orchestration, embedding
+dumps, chunked sharded max-inner-product search."""

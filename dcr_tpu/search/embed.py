@@ -1,0 +1,182 @@
+"""Stage 1+2 of the LAION pipeline: download a chunk, embed it, dump features.
+
+Capability-equivalent of embedding_search/download_and_generate_embedding.py
+(40-104) + utils.py (15-133): img2dataset parquet→webdataset download
+(host-side, orchestrated not reimplemented), SSCD embedding of the tars or of
+any image folder, and an on-disk embedding dump. The reference's dump is a
+pickle {'features': tensor, 'indexes': list} (utils.py:95-97); we write
+compressed .npz (features float32 [N,D], indexes) and *read* either format so
+existing reference dumps interoperate. The reference's call-signature crash
+(download_and_generate_embedding.py:93-94 passes 5 args to a 4-arg function —
+SURVEY.md §2.4) has no equivalent here by construction.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import tarfile
+from pathlib import Path
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from PIL import Image
+
+from dcr_tpu.core.config import SearchConfig
+from dcr_tpu.eval.features import (
+    IMAGENET_NORM,
+    EvalImageFolder,
+    extract_features,
+    make_extractor,
+)
+from dcr_tpu.models.resnet import init_sscd
+from dcr_tpu.parallel import mesh as pmesh
+
+log = logging.getLogger("dcr_tpu")
+
+
+def download_laion_chunk(parquet_path: str, out_folder: str, *,
+                         image_size: int = 256, processes: int = 16,
+                         threads: int = 32) -> None:
+    """img2dataset orchestration (reference download stage, 59-77). The tool is
+    not bundled in this environment; raise with the exact command so the user
+    can run it where network access exists."""
+    try:
+        import img2dataset
+    except ImportError:
+        raise RuntimeError(
+            "img2dataset is not installed in this environment. Run the download "
+            f"stage on a networked host:\n  img2dataset --url_list {parquet_path} "
+            f"--input_format parquet --url_col URL --caption_col TEXT "
+            f"--output_format webdataset --output_folder {out_folder} "
+            f"--image_size {image_size} --processes_count {processes} "
+            f"--thread_count {threads} --resize_mode center_crop"
+        ) from None
+    img2dataset.download(
+        url_list=parquet_path, input_format="parquet", url_col="URL",
+        caption_col="TEXT", output_format="webdataset",
+        output_folder=out_folder, image_size=image_size,
+        processes_count=processes, thread_count=threads,
+        resize_mode="center_crop")
+
+
+def iter_webdataset_images(tar_paths: list[Path], image_size: int,
+                           ) -> Iterator[tuple[str, np.ndarray]]:
+    """(key, image [H,W,3] float32 in [0,1]) from webdataset-style tars —
+    replaces the reference's webdataset loader (utils.py:52-63) with a
+    dependency-free reader."""
+    from dcr_tpu.data.dataset import _resize_shorter_side
+
+    for tar_path in tar_paths:
+        with tarfile.open(tar_path) as tf:
+            for member in tf:
+                suffix = Path(member.name).suffix.lower()
+                if suffix not in (".jpg", ".jpeg", ".png", ".webp"):
+                    continue
+                data = tf.extractfile(member)
+                if data is None:
+                    continue
+                try:
+                    with Image.open(io.BytesIO(data.read())) as img:
+                        img = img.convert("RGB")
+                        img = _resize_shorter_side(img, image_size)
+                        w, h = img.size
+                        left, top = (w - image_size) // 2, (h - image_size) // 2
+                        img = img.crop((left, top, left + image_size,
+                                        top + image_size))
+                        arr = np.asarray(img, np.float32) / 255.0
+                except Exception as e:  # corrupt shards are expected at scale
+                    log.warning("skipping corrupt member %s in %s (%s)",
+                                member.name, tar_path.name, e)
+                    continue
+                yield f"{tar_path.stem}/{Path(member.name).stem}", arr
+
+
+def save_embeddings(path: str | Path, features: np.ndarray,
+                    indexes: list[str]) -> None:
+    np.savez_compressed(path, features=np.asarray(features, np.float32),
+                        indexes=np.asarray(indexes))
+
+
+def load_embeddings(path: str | Path) -> tuple[np.ndarray, list[str]]:
+    """Read our .npz dumps or the reference's pickle format."""
+    path = Path(path)
+    if path.suffix == ".npz" or path.name.endswith(".npz"):
+        with np.load(path, allow_pickle=False) as z:
+            return np.asarray(z["features"], np.float32), [str(i) for i in z["indexes"]]
+    with open(path, "rb") as f:
+        d = pickle.load(f)
+    feats = d["features"]
+    if hasattr(feats, "numpy"):  # torch tensor from the reference toolchain
+        feats = feats.numpy()
+    return np.asarray(feats, np.float32), [str(i) for i in d["indexes"]]
+
+
+def find_embedding_file(folder: str | Path) -> Optional[Path]:
+    folder = Path(folder)
+    for name in ("embedding.npz", "embedding.pkl", "embedding.pickle"):
+        if (folder / name).exists():
+            return folder / name
+    return None
+
+
+def embed_images(cfg: SearchConfig, *, source: str | Path,
+                 sscd_params: Optional[dict] = None,
+                 out_path: Optional[str | Path] = None) -> Path:
+    """Embed an image folder or a dir of webdataset tars with SSCD; dump .npz."""
+    mesh = pmesh.make_mesh(cfg.mesh)
+    model, params = init_sscd(jax.random.key(0), image_size=cfg.image_size)
+    if sscd_params is not None:
+        params = sscd_params
+    extractor = make_extractor(
+        lambda p, x: model.apply({"params": p}, x), params, mesh)
+
+    source = Path(source)
+    tars = sorted(source.glob("*.tar"))
+    feats_list, keys = [], []
+    # reference embedding pipeline normalizes with ImageNet stats
+    # (embedding_search/utils.py:35-40)
+    norm_mean = np.asarray(IMAGENET_NORM[0], np.float32)
+    norm_std = np.asarray(IMAGENET_NORM[1], np.float32)
+    if tars:
+        batch_imgs, batch_keys = [], []
+
+        def flush():
+            if not batch_imgs:
+                return
+            arr = np.stack(batch_imgs)
+            out = pmesh.to_host(extractor(arr))
+            feats_list.append(out)
+            keys.extend(batch_keys)
+            batch_imgs.clear()
+            batch_keys.clear()
+
+        for key, img in iter_webdataset_images(tars, cfg.image_size):
+            batch_imgs.append((img - norm_mean) / norm_std)
+            batch_keys.append(key)
+            if len(batch_imgs) == cfg.batch_size:
+                flush()
+        flush()
+        features = np.concatenate(feats_list) if feats_list else np.zeros((0, 512))
+    else:
+        folder = EvalImageFolder(source, cfg.image_size,
+                                 resize_to=round(cfg.image_size * 256 / 224),
+                                 normalize=IMAGENET_NORM)
+        features = extract_features(folder, extractor, batch_size=cfg.batch_size)
+        keys = [str(p) for p in folder.paths]
+
+    out_path = Path(out_path or (source / "embedding.npz"))
+    save_embeddings(out_path, features, keys)
+    log.info("embedded %d images from %s -> %s", len(keys), source, out_path)
+    return out_path
+
+
+def cleanup_tars(folder: str | Path) -> int:
+    """Delete tars after embedding (reference stage 3, 102-104)."""
+    n = 0
+    for tar in Path(folder).glob("*.tar"):
+        tar.unlink()
+        n += 1
+    return n

@@ -1,0 +1,110 @@
+"""Stage 3: chunked brute-force max-inner-product search of generations
+against every LAION chunk's embedding dump.
+
+Capability-equivalent of embedding_search/similarity_search.py (22-91): the
+generation embeddings are split into chunks, each LAION folder's embeddings are
+streamed through device matmuls, and a running top-k (reference: top-1)
+score/key table is merged across chunks. The reference's crashes — the
+mis-named args.laion_embeddings_folders flag (line 34 vs 16) and the swapped
+open/pickle.dump arguments (90-91) — have no equivalent here; results land in
+a .npz with named fields.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from pathlib import Path
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcr_tpu.core.config import SearchConfig
+from dcr_tpu.search.embed import find_embedding_file, load_embeddings
+
+log = logging.getLogger("dcr_tpu")
+
+
+def topk_merge(scores: np.ndarray, keys: np.ndarray, new_scores: np.ndarray,
+               new_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Merge two [N, K] top-k tables (scores desc) into one."""
+    all_scores = np.concatenate([scores, new_scores], axis=1)
+    all_keys = np.concatenate([keys, new_keys], axis=1)
+    order = np.argsort(-all_scores, axis=1)[:, : scores.shape[1]]
+    return (np.take_along_axis(all_scores, order, axis=1),
+            np.take_along_axis(all_keys, order, axis=1))
+
+
+def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
+                   laion_folders: Sequence[str | Path], *, top_k: int = 1,
+                   num_chunks: int = 20) -> dict:
+    """Running top-k of every generation against all LAION chunks.
+
+    Returns {"scores": [N,K], "keys": [N,K] laion ids, "gen_images": [N]}.
+    """
+    n = len(gen_features)
+    num_chunks = max(1, min(num_chunks, n))
+    chunk_size = -(-n // num_chunks)
+    best_scores = np.full((n, top_k), -np.inf, np.float32)
+    best_keys = np.full((n, top_k), "", dtype=object)
+
+    matmul = jax.jit(lambda a, b: a @ b.T)
+
+    for folder in laion_folders:
+        emb_file = find_embedding_file(folder)
+        if emb_file is None:
+            log.warning("no embedding dump under %s; skipping", folder)
+            continue
+        try:
+            feats, keys = load_embeddings(emb_file)
+        except Exception as e:  # tolerate corrupt chunks (reference 51-56)
+            log.warning("corrupt embedding dump %s (%s); skipping", emb_file, e)
+            continue
+        if not len(feats):
+            continue
+        t0 = time.time()
+        keys_arr = np.asarray(keys, dtype=object)
+        feats_j = jnp.asarray(feats)
+        for start in range(0, n, chunk_size):
+            gen_chunk = jnp.asarray(gen_features[start:start + chunk_size])
+            sims = np.asarray(jax.device_get(matmul(gen_chunk, feats_j)))
+            k = min(top_k, sims.shape[1])
+            top_idx = np.argpartition(-sims, k - 1, axis=1)[:, :k]
+            top_scores = np.take_along_axis(sims, top_idx, axis=1)
+            order = np.argsort(-top_scores, axis=1)
+            top_idx = np.take_along_axis(top_idx, order, axis=1)
+            top_scores = np.take_along_axis(top_scores, order, axis=1)
+            if k < top_k:  # pad tiny chunks
+                pad = top_k - k
+                top_scores = np.pad(top_scores, ((0, 0), (0, pad)),
+                                    constant_values=-np.inf)
+                top_idx = np.pad(top_idx, ((0, 0), (0, pad)))
+            sl = slice(start, start + len(top_scores))
+            best_scores[sl], best_keys[sl] = topk_merge(
+                best_scores[sl], best_keys[sl],
+                top_scores, keys_arr[top_idx])
+        log.info("searched %s (%d embeddings) in %.1fs", folder, len(feats),
+                 time.time() - t0)
+    return {"scores": best_scores, "keys": best_keys,
+            "gen_images": np.asarray(list(gen_keys), dtype=object)}
+
+
+def run_search(cfg: SearchConfig, *, laion_folders: Sequence[str | Path],
+               top_k: int = 1) -> Path:
+    """Full stage: load gen embeddings, search all folders, dump results."""
+    gen_emb = find_embedding_file(cfg.gen_folder)
+    if gen_emb is None:
+        raise FileNotFoundError(
+            f"no embedding dump under {cfg.gen_folder}; run search.embed first")
+    gen_features, gen_keys = load_embeddings(gen_emb)
+    result = search_folders(gen_features, gen_keys, laion_folders,
+                            top_k=top_k, num_chunks=cfg.num_chunks)
+    out = Path(cfg.out_path)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    np.savez(out, scores=result["scores"],
+             keys=result["keys"].astype(str),
+             gen_images=result["gen_images"].astype(str))
+    log.info("search results -> %s", out)
+    return out

@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: profiling/MFU telemetry, git provenance."""

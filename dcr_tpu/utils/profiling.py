@@ -1,0 +1,89 @@
+"""Profiling + MFU telemetry.
+
+The reference has no tracing at all (SURVEY.md §5.1 — its closest artifact is
+MetricLogger's iter/data timing). On TPU this is cheap and first-class:
+jax.profiler trace capture around any code region, a step timer, and
+model-FLOPs-utilization accounting against the chip's peak.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import numpy as np
+
+# dense peak TFLOP/s (bf16) per chip by TPU generation; used for MFU.
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5 lite": 197.0,   # v5e
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,   # trillium
+    "cpu": 1.0,
+}
+
+
+def chip_peak_tflops() -> float:
+    kind = jax.devices()[0].device_kind.lower()
+    for name, peak in PEAK_TFLOPS.items():
+        if name in kind:
+            return peak
+    return PEAK_TFLOPS["cpu"]
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """jax.profiler trace capture around a region; view with tensorboard."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs estimate of a jitted function from XLA's cost analysis."""
+    try:
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        analysis = lowered.compile().cost_analysis()
+        if isinstance(analysis, list):  # older jax returns per-device list
+            analysis = analysis[0]
+        return float(analysis.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+@dataclass
+class StepTimer:
+    """Steady-state step timing + images/sec + MFU."""
+
+    flops_per_step: Optional[float] = None
+    _t0: float = field(default_factory=time.perf_counter)
+    _steps: int = 0
+    _items: int = 0
+
+    def tick(self, items: int = 0) -> None:
+        self._steps += 1
+        self._items += items
+
+    def report(self, reset: bool = True) -> dict:
+        dt = time.perf_counter() - self._t0
+        steps = max(self._steps, 1)
+        out = {
+            "step_time_ms": 1e3 * dt / steps,
+            "steps_per_sec": steps / dt if dt > 0 else float("inf"),
+        }
+        if self._items:
+            out["items_per_sec"] = self._items / dt
+        if self.flops_per_step:
+            achieved = self.flops_per_step * steps / dt / 1e12
+            out["tflops_per_sec"] = achieved
+            out["mfu"] = achieved / (chip_peak_tflops() * jax.device_count())
+        if reset:
+            self._t0 = time.perf_counter()
+            self._steps = self._items = 0
+        return out

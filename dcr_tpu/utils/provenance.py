@@ -1,0 +1,41 @@
+"""Run provenance: git state stamped into every run dir.
+
+Equivalent of the reference's get_sha helper (utils_ret.py:420-437), wired in
+rather than dead: Trainer/run_eval call :func:`stamp` so each output dir
+records exactly what code produced it.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def _git(args: list[str], cwd: Path) -> str:
+    try:
+        return subprocess.run(["git", *args], cwd=cwd, capture_output=True,
+                              text=True, timeout=10).stdout.strip()
+    except Exception:
+        return "unknown"
+
+
+def describe(repo_root: Path | None = None) -> dict:
+    root = repo_root or Path(__file__).resolve().parents[2]
+    return {
+        "sha": _git(["rev-parse", "HEAD"], root),
+        "branch": _git(["rev-parse", "--abbrev-ref", "HEAD"], root),
+        "dirty": bool(_git(["status", "--porcelain"], root)),
+        "python": sys.version.split()[0],
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+
+
+def stamp(out_dir: str | Path) -> Path:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / "provenance.json"
+    path.write_text(json.dumps(describe(), indent=2) + "\n")
+    return path

@@ -1,0 +1,132 @@
+import io
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcr_tpu.core.config import SearchConfig
+from dcr_tpu.search import embed as E
+from dcr_tpu.search import search as S
+
+
+def _write_tar(path, names, rng):
+    with tarfile.open(path, "w") as tf:
+        for name in names:
+            buf = io.BytesIO()
+            Image.fromarray(rng.integers(0, 255, (32, 32, 3), np.uint8)).save(
+                buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+
+
+def test_embedding_roundtrip_npz_and_reference_pickle(tmp_path):
+    feats = np.random.default_rng(0).standard_normal((5, 8)).astype(np.float32)
+    keys = [f"k{i}" for i in range(5)]
+    E.save_embeddings(tmp_path / "embedding.npz", feats, keys)
+    f2, k2 = E.load_embeddings(tmp_path / "embedding.npz")
+    np.testing.assert_array_equal(f2, feats)
+    assert k2 == keys
+    # reference-format pickle reads too
+    with open(tmp_path / "embedding.pkl", "wb") as f:
+        pickle.dump({"features": feats, "indexes": keys}, f)
+    f3, k3 = E.load_embeddings(tmp_path / "embedding.pkl")
+    np.testing.assert_array_equal(f3, feats)
+    assert k3 == keys
+    assert E.find_embedding_file(tmp_path).name == "embedding.npz"
+
+
+def test_iter_webdataset_images_skips_corrupt(tmp_path):
+    rng = np.random.default_rng(0)
+    _write_tar(tmp_path / "000.tar", ["a.jpg", "b.jpg"], rng)
+    # corrupt member
+    with tarfile.open(tmp_path / "001.tar", "w") as tf:
+        info = tarfile.TarInfo("bad.jpg")
+        payload = b"not an image"
+        info.size = len(payload)
+        tf.addfile(info, io.BytesIO(payload))
+        buf = io.BytesIO()
+        Image.fromarray(rng.integers(0, 255, (16, 16, 3), np.uint8)).save(
+            buf, format="PNG")
+        info2 = tarfile.TarInfo("ok.png")
+        info2.size = buf.tell()
+        buf.seek(0)
+        tf.addfile(info2, buf)
+    items = list(E.iter_webdataset_images(sorted(tmp_path.glob("*.tar")), 16))
+    names = [k for k, _ in items]
+    assert names == ["000/a", "000/b", "001/ok"]
+    assert items[0][1].shape == (16, 16, 3)
+
+
+def test_embed_images_from_tars_and_folder(tmp_path, cpu_devices):
+    rng = np.random.default_rng(0)
+    tar_dir = tmp_path / "laion"
+    tar_dir.mkdir()
+    _write_tar(tar_dir / "000.tar", [f"{i}.jpg" for i in range(5)], rng)
+    cfg = SearchConfig(image_size=32, batch_size=2)
+    out = E.embed_images(cfg, source=tar_dir)
+    feats, keys = E.load_embeddings(out)
+    assert feats.shape == (5, 512) and len(keys) == 5
+
+    folder = tmp_path / "gens"
+    folder.mkdir()
+    for i in range(3):
+        Image.fromarray(rng.integers(0, 255, (32, 32, 3), np.uint8)).save(
+            folder / f"{i}.png")
+    out2 = E.embed_images(cfg, source=folder)
+    feats2, keys2 = E.load_embeddings(out2)
+    assert feats2.shape == (3, 512)
+
+
+def test_topk_merge():
+    s = np.array([[0.9, 0.5], [0.3, 0.1]])
+    k = np.array([["a", "b"], ["c", "d"]], dtype=object)
+    ns = np.array([[0.7, 0.1], [0.8, 0.2]])
+    nk = np.array([["x", "y"], ["z", "w"]], dtype=object)
+    ms, mk = S.topk_merge(s, k, ns, nk)
+    np.testing.assert_allclose(ms, [[0.9, 0.7], [0.8, 0.3]])
+    assert mk.tolist() == [["a", "x"], ["z", "c"]]
+
+
+def test_search_end_to_end(tmp_path, cpu_devices):
+    rng = np.random.default_rng(0)
+    # two laion folders with known embeddings; gen 0 matches laion1/k1 exactly
+    d = 16
+    gen = rng.standard_normal((4, d)).astype(np.float32)
+    gen /= np.linalg.norm(gen, axis=1, keepdims=True)
+    l1 = rng.standard_normal((10, d)).astype(np.float32) * 0.1
+    l1[3] = gen[0]  # exact copy
+    l2 = rng.standard_normal((7, d)).astype(np.float32) * 0.1
+    l2[5] = gen[1] * 0.9
+    for i, (folder, feats) in enumerate([("laion1", l1), ("laion2", l2)]):
+        fdir = tmp_path / folder
+        fdir.mkdir()
+        E.save_embeddings(fdir / "embedding.npz", feats,
+                          [f"{folder}_img{j}" for j in range(len(feats))])
+    gdir = tmp_path / "gens"
+    gdir.mkdir()
+    E.save_embeddings(gdir / "embedding.npz", gen, [f"g{i}" for i in range(4)])
+    # corrupt folder tolerated
+    bad = tmp_path / "bad"
+    bad.mkdir()
+    (bad / "embedding.npz").write_bytes(b"garbage")
+
+    cfg = SearchConfig(gen_folder=str(gdir), out_path=str(tmp_path / "res.npz"),
+                       num_chunks=3)
+    out = S.run_search(cfg, laion_folders=[tmp_path / "laion1",
+                                           tmp_path / "laion2", bad,
+                                           tmp_path / "missing"])
+    with np.load(out, allow_pickle=False) as z:
+        scores, keys, gens = z["scores"], z["keys"], z["gen_images"]
+    assert keys[0, 0] == "laion1_img3"
+    assert scores[0, 0] == pytest.approx(1.0, abs=1e-5)
+    assert keys[1, 0] == "laion2_img5"
+    assert list(gens) == ["g0", "g1", "g2", "g3"]
+
+
+def test_download_raises_with_command_when_tool_missing(tmp_path):
+    with pytest.raises(RuntimeError, match="img2dataset"):
+        E.download_laion_chunk("part.parquet", str(tmp_path))

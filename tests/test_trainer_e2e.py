@@ -91,3 +91,18 @@ def test_ema_weights_are_exported(train_setup):
     exp_leaf = np.asarray(jax.tree.leaves(exported)[0])
     np.testing.assert_array_equal(exp_leaf, ema_leaf)
     assert not np.array_equal(exp_leaf, raw_leaf)
+
+
+def test_sample_hook_writes_grids(train_setup):
+    from dcr_tpu.diffusion.sample_hook import make_sample_hook
+
+    cfg, tmp_path = train_setup
+    cfg.output_dir = str(tmp_path / "run_hook")
+    cfg.save_steps = 3
+    cfg.max_train_steps = 3
+    cfg.data.class_prompt = "classlevel"
+    trainer = Trainer(cfg, sample_hook=make_sample_hook(
+        num_inference_steps=2, images_per_prompt=2, max_prompts=2))
+    trainer.train()
+    grids = list((tmp_path / "run_hook" / "generations").glob("step_*.png"))
+    assert grids, "no sample grids written"
