@@ -35,7 +35,8 @@ def main(argv=None) -> None:
         if cfg.delete_tars:
             E.cleanup_tars(cfg.laion_folder)
     elif command == "embed":
-        E.embed_images(cfg, source=cfg.gen_folder, out_path=cfg.embedding_out)
+        E.embed_images(cfg, source=cfg.gen_folder,
+                       out_path=cfg.embedding_out or None)
     elif command == "search":
         folders = sorted(p for p in Path(cfg.laion_folder).iterdir() if p.is_dir())
         S.run_search(cfg, laion_folders=folders)

@@ -42,22 +42,25 @@ INFERENCE_AUGS = ("none", "rand_numb_add", "rand_word_add", "rand_word_repeat")
 @dataclass
 class MeshConfig:
     """Device-mesh shape. Axes with size 1 are still named so sharding rules are
-    uniform from 1 chip to a multi-host pod (SURVEY.md §5.8)."""
+    uniform from 1 chip to a multi-host pod (SURVEY.md §5.8). `seq` is the
+    sequence/context-parallel axis consumed by ops.ring_attention."""
 
     data: int = -1  # -1: all remaining devices
     fsdp: int = 1
     tensor: int = 1
+    seq: int = 1
 
-    def axis_sizes(self, n_devices: int) -> tuple[int, int, int]:
-        d, f, t = self.data, self.fsdp, self.tensor
-        known = max(1, f) * max(1, t)
+    def axis_sizes(self, n_devices: int) -> tuple[int, int, int, int]:
+        d, f, t, s = self.data, self.fsdp, self.tensor, self.seq
+        known = max(1, f) * max(1, t) * max(1, s)
         if d == -1:
             if n_devices % known:
-                raise ValueError(f"{n_devices} devices not divisible by fsdp*tensor={known}")
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fsdp*tensor*seq={known}")
             d = n_devices // known
-        if d * f * t != n_devices:
-            raise ValueError(f"mesh {d}x{f}x{t} != {n_devices} devices")
-        return d, f, t
+        if d * f * t * s != n_devices:
+            raise ValueError(f"mesh {d}x{f}x{t}x{s} != {n_devices} devices")
+        return d, f, t, s
 
 
 @dataclass
@@ -237,7 +240,7 @@ class SearchConfig:
     parquet_path: str = ""
     laion_folder: str = ""
     gen_folder: str = ""
-    embedding_out: str = "embedding.npz"
+    embedding_out: str = ""      # default: <gen_folder>/embedding.npz
     out_path: str = "similarity_result.npz"
     num_chunks: int = 20
     batch_size: int = 128

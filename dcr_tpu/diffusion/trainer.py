@@ -136,6 +136,14 @@ class Trainer:
         dist.barrier("export")
         return out
 
+    def _step_flops(self, sharded_batch) -> float:
+        """Per-device FLOPs of the compiled train step (0 if unavailable);
+        feeds the MFU telemetry (SURVEY.md §5.1 — absent in the reference)."""
+        from dcr_tpu.utils.profiling import flops_of_jitted
+
+        return flops_of_jitted(self.step_fn, self.state, sharded_batch,
+                               self.train_key)
+
     # -- the loop ------------------------------------------------------------
 
     def train(self) -> dict:
@@ -147,6 +155,7 @@ class Trainer:
         t_last, imgs_last = time.time(), 0
         last_metrics: dict = {}
         global_bs = cfg.train_batch_size * jax.device_count()
+        flops_per_step: float | None = None  # filled after first compiled step
         log.info("training: %d steps (%d/epoch), global batch %d",
                  max_steps, steps_per_epoch, global_bs)
         while step < max_steps:
@@ -156,10 +165,22 @@ class Trainer:
                 self.state, metrics = self.step_fn(self.state, sharded, self.train_key)
                 step += 1
                 imgs_last += global_bs
+                if flops_per_step is None:
+                    flops_per_step = self._step_flops(sharded)
                 if step % cfg.log_every == 0 or step == max_steps:
                     metrics = jax.device_get(metrics)
                     dt = time.time() - t_last
                     metrics["images_per_sec"] = imgs_last / max(dt, 1e-9)
+                    if flops_per_step:
+                        from dcr_tpu.utils.profiling import chip_peak_tflops
+
+                        # flops_per_step is the per-chip share (post-partition
+                        # cost analysis): per-chip achieved / per-chip peak = MFU
+                        steps_done = imgs_last / global_bs
+                        per_chip = flops_per_step * steps_done / max(dt, 1e-9)
+                        metrics["tflops_per_sec"] = (
+                            per_chip * jax.device_count() / 1e12)
+                        metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
                     self.writer.scalars(step, metrics)
                     last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                     t_last, imgs_last = time.time(), 0

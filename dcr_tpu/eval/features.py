@@ -34,6 +34,12 @@ HALF_NORM = ((0.5, 0.5, 0.5), (0.5, 0.5, 0.5))
 IMAGENET_NORM = ((0.485, 0.456, 0.406), (0.229, 0.224, 0.225))
 
 
+def reference_resize_for(crop_size: int) -> int:
+    """Shorter-side resize preceding a center crop, preserving the reference's
+    Resize(256)+CenterCrop(224) ratio at any crop size."""
+    return round(crop_size * 256 / 224)
+
+
 def natsort_key(path: Path):
     """Natural sort (gen_0, gen_2, gen_10) — the reference depends on natsort
     ordering generations to align with prompts.txt lines."""

@@ -40,6 +40,7 @@ from dcr_tpu.eval.features import (
     EvalImageFolder,
     extract_features,
     make_extractor,
+    reference_resize_for,
 )
 from dcr_tpu.models.clip_image import CLIPImageTower, init_clip_scorer, make_clip_scorer
 from dcr_tpu.models.inception import InceptionV3FID
@@ -91,7 +92,7 @@ def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
     if folder.captions is None:
         return float("nan")
     raw = EvalImageFolder(folder.root, clip_image_size,
-                          resize_to=round(clip_image_size * 256 / 224))
+                          resize_to=reference_resize_for(clip_image_size))
     scorer = make_clip_scorer()
     if scorer_params is None:
         scorer_params = init_clip_scorer(jax.random.key(7), scorer, clip_image_size)
@@ -137,7 +138,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
     # reference retrieval transform: Resize(256) + CenterCrop(224) +
     # Normalize([0.5],[0.5]) (diff_retrieval.py:325-329), scaled to image_size
-    resize_to = round(cfg.image_size * 256 / 224)
+    resize_to = reference_resize_for(cfg.image_size)
     query = EvalImageFolder(cfg.query_dir, cfg.image_size, resize_to=resize_to,
                             normalize=HALF_NORM, caption_json=query_caption_json)
     values = EvalImageFolder(cfg.values_dir, cfg.image_size, resize_to=resize_to,

@@ -46,17 +46,24 @@ long jpeg_encoded_size(const unsigned char* data, int height, int width,
   cinfo.err = jpeg_std_error(&jerr.pub);
   jerr.pub.error_exit = error_exit;
 
-  unsigned char* buffer = nullptr;
-  unsigned long buffer_size = 0;
+  // The output pointer lives in a heap slot: locals modified between setjmp
+  // and longjmp are indeterminate afterwards (C11 7.13.2.1), but the slot's
+  // address is set before setjmp and libjpeg updates the slot contents.
+  struct Slot {
+    unsigned char* buffer = nullptr;
+    unsigned long size = 0;
+  };
+  Slot* slot = new Slot();
 
   if (setjmp(jerr.jump)) {
     jpeg_destroy_compress(&cinfo);
-    std::free(buffer);
+    std::free(slot->buffer);
+    delete slot;
     return -1;
   }
 
   jpeg_create_compress(&cinfo);
-  jpeg_mem_dest(&cinfo, &buffer, &buffer_size);
+  jpeg_mem_dest(&cinfo, &slot->buffer, &slot->size);
 
   cinfo.image_width = static_cast<JDIMENSION>(width);
   cinfo.image_height = static_cast<JDIMENSION>(height);
@@ -75,8 +82,9 @@ long jpeg_encoded_size(const unsigned char* data, int height, int width,
   jpeg_finish_compress(&cinfo);
   jpeg_destroy_compress(&cinfo);
 
-  long out = static_cast<long>(buffer_size);
-  std::free(buffer);
+  long out = static_cast<long>(slot->size);
+  std::free(slot->buffer);
+  delete slot;
   return out;
 }
 

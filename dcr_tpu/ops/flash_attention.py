@@ -1,16 +1,18 @@
-"""Pallas TPU flash attention (forward streaming-softmax kernel).
+"""Pallas TPU flash attention — fused forward AND backward kernels.
 
 Replaces the role xformers' CUDA memory-efficient attention plays in the
 reference (diff_train.py:578): O(S) memory attention for the UNet's spatial
 self-attention at 512px+ (S=4096 latent tokens). Classic FlashAttention
-online-softmax over key blocks; logits/statistics accumulate in f32 on the MXU
-regardless of the bf16 compute dtype.
+(Dao et al. 2022):
 
-Backward: custom_vjp recomputes attention with the XLA path (same math — exact
-gradients, no stored S×S matrix in the fwd). A fused Pallas bwd kernel is a
-later optimization; the fwd kernel is what bounds sampling/inference memory.
+- forward: online softmax over key blocks, f32 logits/statistics/accumulator on
+  the MXU while operands stay bf16; also emits the per-row logsumexp.
+- backward: recompute-based fused kernels — dQ with a (q-block × key-loop)
+  grid, dK/dV with a (k-block × query-loop) grid — never materializing the
+  S×S matrix.
 
 Layout contract: [B, S, H, D] at the dispatcher, reshaped to [B*H, S, D] here.
+interpret=True runs the same kernels through the Pallas interpreter (CPU tests).
 """
 
 from __future__ import annotations
@@ -49,8 +51,17 @@ def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
     )
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
-    # keep q/k/v in their native dtype (bf16 hits the MXU at full rate);
+def _mem(interpret: bool) -> dict:
+    return {} if (interpret or _VMEM is None) else {"memory_space": _VMEM}
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
+                block_k: int):
+    # operands stay in their native dtype (bf16 hits the MXU at full rate);
     # logits, softmax statistics, and the accumulator are f32
     q = q_ref[0]                                      # [bq, D]
     sk = k_ref.shape[1]
@@ -77,17 +88,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, block_k: int):
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
-               interpret: bool) -> jax.Array:
-    """q3/k3/v3: [BH, S, D]."""
+               interpret: bool) -> tuple[jax.Array, jax.Array]:
+    """q3/k3/v3: [BH, S, D] -> (out [BH,S,D], lse [BH,S])."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
     scale = 1.0 / (d ** 0.5)
     kernel = functools.partial(_fwd_kernel, scale=scale, block_k=BLOCK_K)
-    mem = {"memory_space": _VMEM} if (not interpret and _VMEM is not None) else {}
-    return pl.pallas_call(
+    mem = _mem(interpret)
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, sq // BLOCK_Q),
         in_specs=[
@@ -95,41 +107,165 @@ def _flash_fwd(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
         ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (recompute; FlashAttention eq. dS = P ∘ (dP − D))
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+                   scale: float, block_k: int):
+    q = q_ref[0]                                       # [bq, D]
+    do = do_ref[0]
+    lse = lse_ref[0][:, None]                          # [bq, 1]
+    delta = delta_ref[0][:, None]
+    sk = k_ref.shape[1]
+    bq, d = q.shape
+    in_dtype = q.dtype
+
+    def body(kb, dq):
+        k_blk = k_ref[0, pl.ds(kb * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                          # [bq, bk] f32
+        return dq + jax.lax.dot_general(
+            ds.astype(in_dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, sk // block_k, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale: float, block_q: int):
+    k_blk = k_ref[0]                                   # [bk, D]
+    v_blk = v_ref[0]
+    sq = q_ref.shape[1]
+    bk, d = k_blk.shape
+    in_dtype = k_blk.dtype
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
+        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        p = jnp.exp(s - lse)                           # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # p^T @ do -> [bk, D]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jax.lax.dot_general(
+            ds.astype(in_dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # ds^T @ q -> [bk, D]
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, sq // block_q, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, interpret: bool):
+    bh, sq, d = q3.shape
+    sk = k3.shape[1]
+    scale = 1.0 / (d ** 0.5)
+    mem = _mem(interpret)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_k=BLOCK_K),
+        grid=(bh, sq // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
+            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
+            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
+        ],
         out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=BLOCK_Q),
+        grid=(bh, sk // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0), **mem),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0), **mem),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0), **mem),
+            pl.BlockSpec((1, sq), lambda b, j: (b, 0), **mem),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
+            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
+        ],
+        interpret=interpret,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
 
 
-def _reference_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """XLA attention on [B, S, H, D]; used for the recompute backward."""
-    scale = 1.0 / (q.shape[-1] ** 0.5)
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                        preferred_element_type=jnp.float32) * scale
-    weights = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", weights.astype(q.dtype), v)
+# ---------------------------------------------------------------------------
+# public op
+# ---------------------------------------------------------------------------
+
+def _to3(x: jax.Array) -> jax.Array:
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _from3(x3: jax.Array, b: int, h: int) -> jax.Array:
+    bh, s, d = x3.shape
+    return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                     interpret: bool = False) -> jax.Array:
-    """Flash attention over [B, S, H, D] tensors. interpret=True runs the same
-    kernel through the Pallas interpreter (CPU tests)."""
-    b, sq, h, d = q.shape
-    sk = k.shape[1]
-    to3 = lambda x, s: x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    o3 = _flash_fwd(to3(q, sq), to3(k, sk), to3(v, sk), interpret=interpret)
-    return o3.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    """Flash attention over [B, S, H, D] tensors."""
+    out, _ = _flash_fwd(_to3(q), _to3(k), _to3(v), interpret=interpret)
+    return _from3(out, q.shape[0], q.shape[2])
 
 
 def _fwd_rule(q, k, v, interpret):
-    return flash_attention(q, k, v, interpret), (q, k, v)
+    q3, k3, v3 = _to3(q), _to3(k), _to3(v)
+    o3, lse = _flash_fwd(q3, k3, v3, interpret=interpret)
+    b, h = q.shape[0], q.shape[2]
+    return _from3(o3, b, h), (q3, k3, v3, o3, lse, b, h)
 
 
 def _bwd_rule(interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(_reference_attention, q, k, v)
-    return vjp(g)
+    q3, k3, v3, o3, lse, b, h = residuals
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, _to3(g), interpret=interpret)
+    return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h)
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -1,0 +1,92 @@
+"""Ring attention: exact attention over sequences sharded across chips.
+
+Long-context/sequence parallelism is first-class in this framework (the
+reference's only attention-scaling measure is single-GPU xformers,
+diff_train.py:578 — SURVEY.md §5.7): queries stay resident on their chip while
+key/value shards rotate around the mesh's `seq` axis via ``ppermute`` (ICI
+neighbor exchange), with FlashAttention-style online-softmax merging of each
+visiting block. Per-chip memory is O(S_local²) and the result is *exact* full
+attention over the global sequence — the TPU-native equivalent of
+RingAttention (Liu et al. 2023) / context parallelism.
+
+Usage: wrap in shard_map over the seq axis (see :func:`ring_self_attention`)
+or call :func:`ring_attention` directly inside an existing shard_map with the
+axis name.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcr_tpu.parallel.mesh import SEQ_AXIS
+
+
+def _block_update(q: jax.Array, k_blk: jax.Array, v_blk: jax.Array,
+                  m: jax.Array, l: jax.Array, acc: jax.Array, scale: float
+                  ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Online-softmax merge of one visiting K/V block. q [B,Sq,H,D];
+    k_blk/v_blk [B,Sk,H,D]; m/l [B,H,Sq,1]; acc [B,Sq,H,D] (f32)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) * scale
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                                   # [B,H,Sq,Sk]
+    corr = jnp.exp(m - m_new)                                # [B,H,Sq,1]
+    l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v_blk.dtype), v_blk)
+    acc_new = acc * corr.transpose(0, 2, 1, 3) + pv.astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str = SEQ_AXIS) -> jax.Array:
+    """Exact attention with K/V rotating around `axis_name`.
+
+    Call inside shard_map/pmap with q/k/v being the *local* sequence shards
+    [B, S_local, H, D]. Returns the local output shard [B, S_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    b, sq, h, d = q.shape
+
+    m0 = jnp.full((b, h, sq, 1), -1e30, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    acc0 = jnp.zeros((b, sq, h, d), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        k_blk, v_blk, m, l, acc = carry
+        m, l, acc = _block_update(q, k_blk, v_blk, m, l, acc, scale)
+        # rotate K/V to the next chip over ICI (overlaps with next step's
+        # compute under XLA's latency-hiding scheduler)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_blk, v_blk, m, l, acc), ()
+
+    # n-1 update+rotate steps, then a final update with no trailing exchange
+    # (the last rotation's result would be discarded — pure wasted ICI traffic)
+    carry = (k, v, m0, l0, acc0)
+    if n > 1:
+        carry, _ = jax.lax.scan(step, carry, None, length=n - 1)
+    k, v, m, l, acc = carry
+    m, l, acc = _block_update(q, k, v, m, l, acc, scale)
+    out = acc / l.transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def ring_self_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
+                        batch_axes: tuple[str, ...] = ("data", "fsdp")
+                        ) -> jax.Array:
+    """shard_map wrapper: q/k/v are GLOBAL [B, S, H, D] arrays; the sequence
+    axis is sharded over the mesh's `seq` axis, batch over the batch axes."""
+    spec = P(batch_axes, SEQ_AXIS, None, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=SEQ_AXIS),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

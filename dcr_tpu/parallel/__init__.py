@@ -3,6 +3,7 @@ from dcr_tpu.parallel.mesh import (  # noqa: F401
     AXES,
     DATA_AXIS,
     FSDP_AXIS,
+    SEQ_AXIS,
     TENSOR_AXIS,
     batch_sharding,
     data_parallel_size,

@@ -26,15 +26,16 @@ from dcr_tpu.core.config import MeshConfig
 DATA_AXIS = "data"
 FSDP_AXIS = "fsdp"
 TENSOR_AXIS = "tensor"
-AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS)
+SEQ_AXIS = "seq"
+AXES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQ_AXIS)
 
 
 def make_mesh(cfg: Optional[MeshConfig] = None,
               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     cfg = cfg or MeshConfig()
     devices = list(devices if devices is not None else jax.devices())
-    d, f, t = cfg.axis_sizes(len(devices))
-    arr = np.asarray(devices).reshape(d, f, t)
+    d, f, t, s = cfg.axis_sizes(len(devices))
+    arr = np.asarray(devices).reshape(d, f, t, s)
     return Mesh(arr, AXES)
 
 

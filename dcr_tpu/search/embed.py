@@ -30,6 +30,7 @@ from dcr_tpu.eval.features import (
     EvalImageFolder,
     extract_features,
     make_extractor,
+    reference_resize_for,
 )
 from dcr_tpu.models.resnet import init_sscd
 from dcr_tpu.parallel import mesh as pmesh
@@ -162,7 +163,7 @@ def embed_images(cfg: SearchConfig, *, source: str | Path,
         features = np.concatenate(feats_list) if feats_list else np.zeros((0, 512))
     else:
         folder = EvalImageFolder(source, cfg.image_size,
-                                 resize_to=round(cfg.image_size * 256 / 224),
+                                 resize_to=reference_resize_for(cfg.image_size),
                                  normalize=IMAGENET_NORM)
         features = extract_features(folder, extractor, batch_size=cfg.batch_size)
         keys = [str(p) for p in folder.paths]

@@ -45,6 +45,10 @@ def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
     Returns {"scores": [N,K], "keys": [N,K] laion ids, "gen_images": [N]}.
     """
     n = len(gen_features)
+    if n == 0:
+        return {"scores": np.zeros((0, top_k), np.float32),
+                "keys": np.zeros((0, top_k), dtype=object),
+                "gen_images": np.asarray([], dtype=object)}
     num_chunks = max(1, min(num_chunks, n))
     chunk_size = -(-n // num_chunks)
     best_scores = np.full((n, top_k), -np.inf, np.float32)

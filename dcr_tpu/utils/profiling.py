@@ -45,16 +45,22 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
-def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
-    """FLOPs estimate of a jitted function from XLA's cost analysis."""
+def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
+    """Per-device FLOPs of an already-jitted function from XLA's cost analysis
+    (post-GSPMD-partitioning, so this is the per-chip share). 0 if unavailable."""
     try:
-        lowered = jax.jit(fn).lower(*args, **kwargs)
-        analysis = lowered.compile().cost_analysis()
+        compiled = jitted_fn.lower(*args, **kwargs).compile()
+        analysis = compiled.cost_analysis()
         if isinstance(analysis, list):  # older jax returns per-device list
             analysis = analysis[0]
-        return float(analysis.get("flops", 0.0)) or None
+        return float(analysis.get("flops", 0.0))
     except Exception:
-        return None
+        return 0.0
+
+
+def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
+    """FLOPs estimate of a function from XLA's cost analysis."""
+    return flops_of_jitted(jax.jit(fn), *args, **kwargs) or None
 
 
 @dataclass

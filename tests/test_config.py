@@ -80,7 +80,8 @@ def test_validation_rules():
 
 def test_mesh_axis_sizes():
     m = C.MeshConfig(data=-1, fsdp=2, tensor=1)
-    assert m.axis_sizes(8) == (4, 2, 1)
+    assert m.axis_sizes(8) == (4, 2, 1, 1)
+    assert C.MeshConfig(data=-1, seq=4).axis_sizes(8) == (2, 1, 1, 4)
     with pytest.raises(ValueError):
         C.MeshConfig(data=3, fsdp=2, tensor=1).axis_sizes(8)
 

@@ -73,3 +73,27 @@ def test_softmax_stability_large_logits():
     assert np.all(np.isfinite(np.asarray(out)))
     ref = A.dot_product_attention(q, k, v, use_flash=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-4, rtol=2e-4)
+
+
+def test_fused_backward_rectangular_and_bf16():
+    """dK/dV kernel loops over query blocks (sq != sk) and bf16 grads stay
+    close to the f32 XLA reference."""
+    q, k, v = _rand_qkv(jax.random.key(7), b=1, sq=512, sk=256, h=2, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(FA.flash_attention(q, k, v, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(A.dot_product_attention(q, k, v, use_flash=False) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4)
+
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    gb = jax.grad(lambda *xs: jnp.sum(FA.flash_attention(*xs, True).astype(jnp.float32) ** 2),
+                  argnums=(0, 1, 2))(qb, kb, vb)
+    for a, b in zip(gb, gr):
+        np.testing.assert_allclose(np.asarray(a, dtype=np.float32), np.asarray(b),
+                                   atol=0.15, rtol=0.1)
