@@ -72,9 +72,14 @@ def bench(batch_size: int, steps: int = 10):
 
 
 def main():
+    import os
+
     value = None
     err = None
-    for bs in (16, 8, 4):
+    ladder = (8, 4, 2)  # conservative: each failed attempt costs a full compile
+    if os.environ.get("BENCH_BS"):
+        ladder = (int(os.environ["BENCH_BS"]),)
+    for bs in ladder:
         try:
             value = bench(bs)
             break

@@ -62,6 +62,23 @@ def trainable_of(state: TrainState, train_text_encoder: bool) -> dict:
     return t
 
 
+def resolve_scale_lr(cfg: TrainConfig) -> TrainConfig:
+    """Fold the reference's scale_lr semantics (lr × grad-accum × per-device
+    batch × device count) into a NEW config with scale_lr cleared. Called by
+    every optimizer-building path so direct train.py users get it too; the
+    caller's config object is never mutated."""
+    if not cfg.optim.scale_lr:
+        return cfg
+    import dataclasses
+
+    new_optim = dataclasses.replace(
+        cfg.optim, scale_lr=False,
+        learning_rate=cfg.optim.learning_rate
+        * cfg.optim.gradient_accumulation_steps
+        * cfg.train_batch_size * jax.device_count())
+    return dataclasses.replace(cfg, optim=new_optim)
+
+
 def make_lr_schedule(cfg: OptimConfig) -> optax.Schedule:
     """The reference's get_scheduler surface (diff_train.py:506-511)."""
     lr = cfg.learning_rate
@@ -101,6 +118,7 @@ def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
 
 def init_train_state(cfg: TrainConfig, models: DiffusionModels, *,
                      unet_params, text_params, vae_params) -> TrainState:
+    cfg = resolve_scale_lr(cfg)
     tx = make_optimizer(cfg.optim)
     state = TrainState(
         step=jnp.zeros((), jnp.int32),
@@ -118,12 +136,16 @@ def init_train_state(cfg: TrainConfig, models: DiffusionModels, *,
 
 
 def shard_train_state(state: TrainState, mesh) -> TrainState:
-    """Place params/opt-state on the mesh: fsdp-sharded when the axis exists,
-    replicated otherwise; step replicated."""
-    param_sharding = pmesh.fsdp_sharding_for_params(
+    """Place params/opt-state on the mesh: tensor-parallel rules for the UNet's
+    transformer projections when the tensor axis exists, the FSDP
+    largest-axis rule elsewhere, replicated otherwise; step replicated."""
+    from dcr_tpu.parallel.sharding import params_sharding
+
+    tp = mesh.shape[pmesh.TENSOR_AXIS] > 1
+    param_sharding = params_sharding(
         mesh, {"unet": state.unet_params, "text": state.text_params,
                "vae": state.vae_params, "opt": state.opt_state,
-               "ema": state.ema_params})
+               "ema": state.ema_params}, tensor_parallel=tp)
     rep = pmesh.replicated(mesh)
     return TrainState(
         step=jax.device_put(state.step, rep),
@@ -147,6 +169,7 @@ def make_train_step(cfg: TrainConfig, models: DiffusionModels,
     batch: pixel_values [B,H,W,3] f32, input_ids [B,L] int32 — globally sharded
     on the mesh batch axes (use parallel.shard_batch).
     """
+    cfg = resolve_scale_lr(cfg)
     policy = policy_from_string(cfg.mixed_precision)
     tx = make_optimizer(cfg.optim)
     lr_schedule = make_lr_schedule(cfg.optim)

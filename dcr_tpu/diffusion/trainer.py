@@ -59,8 +59,11 @@ class Trainer:
                  sample_hook: Optional[Callable] = None,
                  pretrained_params: Optional[dict] = None):
         validate_train_config(cfg)
-        self.cfg = cfg
         dist.initialize()
+        # resolve scale_lr into a private copy (the caller's config object is
+        # left untouched); the serialized config.json records the effective lr
+        cfg = T.resolve_scale_lr(cfg)
+        self.cfg = cfg
         self.mesh = pmesh.make_mesh(cfg.mesh)
         self.out_dir = Path(cfg.output_dir)
         if dist.is_primary():

@@ -69,28 +69,27 @@ def shard_batch(mesh: Mesh, batch):
     return jax.tree.map(put, batch)
 
 
-def fsdp_sharding_for_params(mesh: Mesh, params, min_size: int = 2 ** 16):
-    """Parameter shardings: shard the largest axis over `fsdp` when it divides
-    evenly and the tensor is big enough to be worth scattering; replicate the rest.
-
-    Returns a pytree of NamedSharding matching `params` (which may be a pytree of
-    arrays or of ShapeDtypeStructs).
-    """
+def fsdp_spec(mesh: Mesh, shape: tuple[int, ...],
+              min_size: int = 2 ** 16) -> PartitionSpec:
+    """The FSDP rule: shard the largest evenly-divisible axis over `fsdp` when
+    the tensor is big enough to be worth scattering, else replicate."""
     fsdp = mesh.shape[FSDP_AXIS]
+    if fsdp > 1 and int(np.prod(shape, dtype=np.int64)) >= min_size:
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for i in order:
+            if shape[i] % fsdp == 0:
+                spec = [None] * len(shape)
+                spec[i] = FSDP_AXIS
+                return P(*spec)
+    return P()
 
-    def spec_for(x) -> NamedSharding:
-        shape = x.shape
-        if fsdp > 1 and np.prod(shape, dtype=np.int64) >= min_size:
-            # shard the largest evenly-divisible dimension
-            order = sorted(range(len(shape)), key=lambda i: -shape[i])
-            for i in order:
-                if shape[i] % fsdp == 0:
-                    spec = [None] * len(shape)
-                    spec[i] = FSDP_AXIS
-                    return NamedSharding(mesh, P(*spec))
-        return NamedSharding(mesh, P())
 
-    return jax.tree.map(spec_for, params)
+def fsdp_sharding_for_params(mesh: Mesh, params, min_size: int = 2 ** 16):
+    """Pytree of NamedSharding matching `params` (arrays or ShapeDtypeStructs)
+    under the FSDP rule."""
+    return jax.tree.map(
+        lambda x: NamedSharding(mesh, fsdp_spec(mesh, tuple(x.shape), min_size)),
+        params)
 
 
 def to_host(x) -> np.ndarray:

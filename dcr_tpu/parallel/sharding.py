@@ -1,0 +1,50 @@
+"""Parameter partition rules: FSDP + Megatron-style tensor parallelism.
+
+The reference has no TP (SURVEY.md §2.2 — optional GSPMD channel sharding
+"later"); here it's a first-class option: transformer-block projections inside
+the UNet shard over the `tensor` mesh axis (qkv/ff-in column-parallel, out/ff-out
+row-parallel) and GSPMD inserts the matching collectives. Everything else
+follows the FSDP largest-axis rule or replicates.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcr_tpu.parallel.mesh import TENSOR_AXIS, fsdp_spec
+
+# column-parallel (shard the output features): qkv projections, ff up-projection
+_COLUMN_PAT = re.compile(r"(to_q|to_k|to_v|ff/proj_in|qkv)/kernel$")
+# row-parallel (shard the input features): attention out, ff down-projection
+_ROW_PAT = re.compile(r"(to_out|ff/proj_out)/kernel$")
+
+
+def _tp_spec(path: str, shape: tuple[int, ...], tensor: int):
+    """PartitionSpec for a UNet param under tensor parallelism, or None."""
+    if tensor <= 1 or len(shape) != 2:
+        return None
+    if _COLUMN_PAT.search(path) and shape[1] % tensor == 0:
+        return P(None, TENSOR_AXIS)
+    if _ROW_PAT.search(path) and shape[0] % tensor == 0:
+        return P(TENSOR_AXIS, None)
+    return None
+
+
+def params_sharding(mesh: Mesh, params, *, tensor_parallel: bool = False,
+                    min_fsdp_size: int = 2 ** 16):
+    """NamedSharding tree: TP rules (when enabled) take precedence, then the
+    shared FSDP largest-divisible-axis rule (mesh.fsdp_spec), else replicate."""
+    tensor = mesh.shape[TENSOR_AXIS] if tensor_parallel else 1
+
+    def spec_for(path_keys, x) -> NamedSharding:
+        path = "/".join(str(getattr(k, "key", k)) for k in path_keys)
+        shape = tuple(x.shape)
+        tp = _tp_spec(path, shape, tensor)
+        if tp is not None:
+            return NamedSharding(mesh, tp)
+        return NamedSharding(mesh, fsdp_spec(mesh, shape, min_fsdp_size))
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -1,0 +1,88 @@
+"""Golden activation fixtures: tiny-shape forward passes recorded once and
+checked on every run, so numeric drift from refactors (layout changes, fusion
+rewrites, epsilon edits) is caught immediately (SURVEY.md §4 item 2 — the
+reference has nothing like this).
+
+Regenerate deliberately after an intended numeric change:
+    python tests/test_goldens.py regenerate
+"""
+
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+GOLDEN_DIR = Path(__file__).parent / "goldens"
+
+
+def _compute_goldens() -> dict[str, np.ndarray]:
+    from dcr_tpu.core.config import ModelConfig
+    from dcr_tpu.models import schedulers as S
+    from dcr_tpu.models.clip_text import init_clip_text
+    from dcr_tpu.models.resnet import init_sscd
+    from dcr_tpu.models.unet2d import init_unet
+    from dcr_tpu.models.vae import init_vae
+
+    cfg = ModelConfig.tiny()
+    out: dict[str, np.ndarray] = {}
+
+    unet, up = init_unet(cfg, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(10), (1, 8, 8, 4))
+    ctx = jax.random.normal(jax.random.key(11), (1, 16, 32))
+    out["unet"] = np.asarray(unet.apply({"params": up}, x, jnp.array([7]), ctx))
+
+    vae, vp = init_vae(cfg, jax.random.key(1))
+    img = jax.random.normal(jax.random.key(12), (1, 16, 16, 3))
+    dist = vae.apply({"params": vp}, img, method=vae.encode)
+    out["vae_mean"] = np.asarray(dist.mean)
+    out["vae_decode"] = np.asarray(
+        vae.apply({"params": vp}, dist.mean, method=vae.decode))
+
+    clip, cp = init_clip_text(cfg, jax.random.key(2))
+    ids = (jnp.arange(16, dtype=jnp.int32)[None] * 7) % cfg.text_vocab_size
+    out["clip_text"] = np.asarray(clip.apply({"params": cp}, ids).last_hidden_state)
+
+    sscd, sp = init_sscd(jax.random.key(3), image_size=32)
+    out["sscd"] = np.asarray(
+        sscd.apply({"params": sp}, jax.random.normal(jax.random.key(13),
+                                                     (1, 32, 32, 3))))
+
+    sched = S.make_schedule()
+    x0 = jax.random.normal(jax.random.key(14), (1, 4, 4, 4))
+    noise = jax.random.normal(jax.random.key(15), x0.shape)
+    out["add_noise"] = np.asarray(S.add_noise(sched, x0, noise, jnp.array([321])))
+    return out
+
+
+def regenerate() -> None:
+    GOLDEN_DIR.mkdir(exist_ok=True)
+    np.savez(GOLDEN_DIR / "tiny_forward.npz", **_compute_goldens())
+    print(f"wrote {GOLDEN_DIR / 'tiny_forward.npz'}")
+
+
+@pytest.mark.skipif(not (GOLDEN_DIR / "tiny_forward.npz").exists(),
+                    reason="no golden fixtures recorded")
+def test_forward_passes_match_goldens():
+    got = _compute_goldens()
+    with np.load(GOLDEN_DIR / "tiny_forward.npz") as z:
+        assert set(got) == set(z.files), (
+            f"golden key set changed (recorded {sorted(z.files)}, computed "
+            f"{sorted(got)}) — regenerate with "
+            "`python tests/test_goldens.py regenerate`")
+        for name in z.files:
+            np.testing.assert_allclose(
+                got[name], z[name], atol=2e-4, rtol=2e-4,
+                err_msg=f"golden drift in {name!r} — if intended, regenerate "
+                        "with `python tests/test_goldens.py regenerate`")
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "regenerate":
+        sys.path.insert(0, str(Path(__file__).parent.parent))  # repo root
+        import jax as _jax
+
+        _jax.config.update("jax_platforms", "cpu")
+        regenerate()

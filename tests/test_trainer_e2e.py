@@ -106,3 +106,16 @@ def test_sample_hook_writes_grids(train_setup):
     trainer.train()
     grids = list((tmp_path / "run_hook" / "generations").glob("step_*.png"))
     assert grids, "no sample grids written"
+
+
+def test_scale_lr(train_setup):
+    cfg, tmp_path = train_setup
+    cfg.output_dir = str(tmp_path / "run_slr")
+    cfg.optim.scale_lr = True
+    cfg.optim.learning_rate = 1e-6
+    trainer = Trainer(cfg)
+    import jax
+
+    expected = 1e-6 * cfg.optim.gradient_accumulation_steps * \
+        cfg.train_batch_size * jax.device_count()
+    assert trainer.cfg.optim.learning_rate == pytest.approx(expected)
