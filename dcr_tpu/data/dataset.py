@@ -55,21 +55,38 @@ def _resize_shorter_side(img: Image.Image, size: int) -> Image.Image:
     return img.resize((nw, nh), Image.BILINEAR)
 
 
+def _open_image(path: str, size: int) -> Image.Image:
+    """PIL image, using the native libjpeg scaled-decode fast path for JPEGs
+    (decodes at a reduced DCT scale >= the target size; dramatically cheaper
+    than full decode for large photos)."""
+    if Path(path).suffix.lower() in (".jpg", ".jpeg"):
+        try:
+            from dcr_tpu.native import jpeg_decoder
+
+            if jpeg_decoder.available():  # avoid double-read when no fast path
+                arr = jpeg_decoder.decode_scaled(Path(path).read_bytes(), size)
+                if arr is not None:
+                    return Image.fromarray(arr)
+        except Exception:
+            pass
+    with Image.open(path) as img:
+        return img.convert("RGB").copy()
+
+
 def load_and_transform(path: str, size: int, *, center_crop: bool,
                        random_flip: bool, rng: np.random.Generator) -> np.ndarray:
     """Decode + resize(shorter side)→crop→flip→normalize to [-1,1] NHWC f32
     (reference transform stack, datasets.py:59-67)."""
-    with Image.open(path) as img:
-        img = img.convert("RGB")
-        img = _resize_shorter_side(img, size)
-        w, h = img.size
-        if center_crop:
-            left, top = (w - size) // 2, (h - size) // 2
-        else:
-            left = int(rng.integers(0, w - size + 1))
-            top = int(rng.integers(0, h - size + 1))
-        img = img.crop((left, top, left + size, top + size))
-        arr = np.asarray(img, np.float32) / 255.0
+    img = _open_image(path, size)
+    img = _resize_shorter_side(img, size)
+    w, h = img.size
+    if center_crop:
+        left, top = (w - size) // 2, (h - size) // 2
+    else:
+        left = int(rng.integers(0, w - size + 1))
+        top = int(rng.integers(0, h - size + 1))
+    img = img.crop((left, top, left + size, top + size))
+    arr = np.asarray(img, np.float32) / 255.0
     if random_flip and rng.uniform() < 0.5:
         arr = arr[:, ::-1, :]
     return arr * 2.0 - 1.0

@@ -172,6 +172,17 @@ class Trainer:
                     flops_per_step = self._step_flops(sharded)
                 if step % cfg.log_every == 0 or step == max_steps:
                     metrics = jax.device_get(metrics)
+                    if not np.isfinite(metrics["loss"]):
+                        # fail fast instead of training on garbage (the
+                        # reference has no such guard, SURVEY §5.2). Do NOT
+                        # save: params already absorbed the non-finite update —
+                        # the last periodic checkpoint is the recovery point.
+                        self.ckpt.wait()  # flush pending async writes
+                        raise FloatingPointError(
+                            f"non-finite loss {metrics['loss']} at step {step}; "
+                            f"resume from the last good checkpoint "
+                            f"(step {self.ckpt.latest_step()}) under "
+                            f"{self.out_dir}/checkpoints")
                     dt = time.time() - t_last
                     metrics["images_per_sec"] = imgs_last / max(dt, 1e-9)
                     if flops_per_step:

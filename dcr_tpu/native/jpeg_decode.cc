@@ -1,0 +1,96 @@
+// JPEG decode + box-downscale helper for the host input pipeline.
+//
+// Role: the training data loader decodes+resizes every sample on host CPU
+// (reference: torchvision/PIL, datasets.py:59-67 — SURVEY.md §2.3 lists the
+// decode path as one of the native dependencies to replace). libjpeg's
+// DCT-domain scaling (scale_num/8) does most of a bilinear Resize for free
+// during decode, which is the expensive part of feeding chips at bs=16×N
+// (SURVEY.md §7.3 "host-side data pipeline throughput"). Python finishes the
+// exact resize/crop on the much smaller intermediate.
+//
+// ctypes ABI (no pybind11 in this image):
+//   jpeg_decode_scaled(buf, len, min_side, out_buf, out_cap, &w, &h) -> 0/-1
+// out_buf receives H*W*3 RGB8; the chosen libjpeg scale is the smallest one
+// whose shorter output side is still >= min_side (so Python's final resize
+// only ever downscales, preserving quality).
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct ErrorMgr {
+  jpeg_error_mgr pub;
+  std::jmp_buf jump;
+};
+
+void error_exit(j_common_ptr cinfo) {
+  ErrorMgr* mgr = reinterpret_cast<ErrorMgr*>(cinfo->err);
+  std::longjmp(mgr->jump, 1);
+}
+
+}  // namespace
+
+extern "C" {
+
+long jpeg_decode_scaled(const unsigned char* data, long size, int min_side,
+                        unsigned char* out, long out_capacity,
+                        int* out_width, int* out_height) {
+  if (!data || size <= 0 || !out || !out_width || !out_height) return -1;
+
+  jpeg_decompress_struct cinfo;
+  ErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = error_exit;
+
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, static_cast<unsigned long>(size));
+  if (jpeg_read_header(&cinfo, TRUE) != JPEG_HEADER_OK) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  cinfo.out_color_space = JCS_RGB;
+
+  // pick the smallest DCT scale (8/8 .. 1/8) keeping shorter side >= min_side
+  const int full_short =
+      cinfo.image_width < cinfo.image_height ? cinfo.image_width
+                                             : cinfo.image_height;
+  int num = 8;
+  if (min_side > 0) {
+    for (int candidate = 1; candidate <= 8; ++candidate) {
+      if (full_short * candidate / 8 >= min_side) {
+        num = candidate;
+        break;
+      }
+    }
+  }
+  cinfo.scale_num = static_cast<unsigned int>(num);
+  cinfo.scale_denom = 8;
+
+  jpeg_start_decompress(&cinfo);
+  const long stride = static_cast<long>(cinfo.output_width) * 3;
+  const long needed = stride * cinfo.output_height;
+  if (needed > out_capacity) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  while (cinfo.output_scanline < cinfo.output_height) {
+    JSAMPROW row = out + static_cast<long>(cinfo.output_scanline) * stride;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  *out_width = static_cast<int>(cinfo.output_width);
+  *out_height = static_cast<int>(cinfo.output_height);
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // extern "C"

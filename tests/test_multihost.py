@@ -1,0 +1,73 @@
+"""Real multi-process DCN test: two localhost processes join via
+jax.distributed, shard a batch across their devices, and verify a global
+reduction + process_allgather (SURVEY.md §4 item 3: 'multi-process DCN paths
+tested with jax.distributed over localhost subprocesses')."""
+
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+WORKER = r"""
+import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.config import MeshConfig
+from dcr_tpu.parallel import make_mesh, shard_batch, to_host
+
+dist.initialize()
+assert jax.process_count() == 2, jax.process_count()
+mesh = make_mesh(MeshConfig())
+rank = dist.process_index()
+# each process contributes its local half of a global batch of 4
+local = {"x": np.arange(2, dtype=np.float32) + 10 * rank}
+batch = shard_batch(mesh, local)
+total = float(jax.jit(lambda b: jnp.sum(b["x"]))(batch))
+assert abs(total - (0 + 1 + 10 + 11)) < 1e-6, total
+gathered = to_host(batch["x"])
+assert gathered.shape == (4,), gathered.shape
+assert sorted(gathered.tolist()) == [0.0, 1.0, 10.0, 11.0], gathered
+print(f"RANK{rank}_OK")
+"""
+
+
+@pytest.mark.slow
+def test_two_process_dcn(tmp_path):
+    port = socket.socket()
+    port.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{port.getsockname()[1]}"
+    port.close()
+    repo = str(Path(__file__).parent.parent)
+    procs = []
+    for rank in range(2):
+        env = {
+            "COORDINATOR_ADDRESS": addr,
+            "NUM_PROCESSES": "2",
+            "PROCESS_ID": str(rank),
+            "PYTHONPATH": repo,
+            "PATH": "/usr/bin:/bin:/usr/local/bin",
+            "HOME": "/tmp",
+        }
+        procs.append(subprocess.Popen([sys.executable, "-c", WORKER], env=env,
+                                      stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process workers timed out")
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+        assert f"RANK{rank}_OK" in out

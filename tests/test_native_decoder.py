@@ -1,0 +1,72 @@
+"""Native JPEG scaled-decode helper: parity with PIL and fast-path wiring."""
+
+import io
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcr_tpu.native import jpeg_decoder
+
+
+def _jpeg_bytes(w, h, seed=0, quality=95):
+    rng = np.random.default_rng(seed)
+    # smooth image so JPEG artifacts are small and PIL-vs-libjpeg comparable
+    base = rng.uniform(0, 255, (8, 8, 3))
+    img = Image.fromarray(base.astype(np.uint8)).resize((w, h), Image.BILINEAR)
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG", quality=quality)
+    return buf.getvalue(), np.asarray(img)
+
+
+def test_decode_full_scale_matches_pil():
+    data, ref = _jpeg_bytes(64, 48)
+    arr = jpeg_decoder.decode_scaled(data, min_side=48)
+    if arr is None:
+        pytest.skip("native decoder unavailable")
+    assert arr.shape == (48, 64, 3)
+    pil = np.asarray(Image.open(io.BytesIO(data)).convert("RGB"), np.float32)
+    assert np.mean(np.abs(arr.astype(np.float32) - pil)) < 2.0
+
+
+def test_decode_downscales_but_covers_min_side():
+    data, _ = _jpeg_bytes(640, 480)
+    arr = jpeg_decoder.decode_scaled(data, min_side=100)
+    if arr is None:
+        pytest.skip("native decoder unavailable")
+    h, w, _ = arr.shape
+    assert min(h, w) >= 100
+    assert min(h, w) < 480  # actually downscaled during decode
+
+
+def test_decode_garbage_returns_none():
+    assert jpeg_decoder.decode_scaled(b"definitely not a jpeg", 64) is None
+    # truncated real jpeg
+    data, _ = _jpeg_bytes(64, 64)
+    out = jpeg_decoder.decode_scaled(data[:40], 32)
+    assert out is None
+
+
+def test_sof_parser():
+    data, _ = _jpeg_bytes(123, 77)
+    assert jpeg_decoder._parse_sof_dims(data) == (123, 77)
+
+
+def test_dataset_fast_path_jpg(tmp_path):
+    from dcr_tpu.core.config import DataConfig
+    from dcr_tpu.data.dataset import ObjectAttributeDataset
+    from dcr_tpu.data.tokenizer import HashTokenizer
+
+    d = tmp_path / "data" / "c"
+    d.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        Image.fromarray(rng.integers(0, 255, (200, 300, 3), np.uint8)).save(
+            d / f"{i}.jpg", quality=95)
+    ds = ObjectAttributeDataset(
+        DataConfig(train_data_dir=str(tmp_path / "data"), resolution=64,
+                   class_prompt="nolevel", num_workers=1),
+        HashTokenizer(100, 16))
+    ex = ds.get(0)
+    assert ex.pixel_values.shape == (64, 64, 3)
+    assert np.isfinite(ex.pixel_values).all()

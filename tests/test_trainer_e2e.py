@@ -119,3 +119,23 @@ def test_scale_lr(train_setup):
     expected = 1e-6 * cfg.optim.gradient_accumulation_steps * \
         cfg.train_batch_size * jax.device_count()
     assert trainer.cfg.optim.learning_rate == pytest.approx(expected)
+
+
+def test_nan_guard_checkpoints_and_raises(train_setup, monkeypatch):
+    cfg, tmp_path = train_setup
+    cfg.output_dir = str(tmp_path / "run_nan")
+    cfg.log_every = 1
+    trainer = Trainer(cfg)
+    real_step = trainer.step_fn
+
+    def poisoned(state, batch, key):
+        state, metrics = real_step(state, batch, key)
+        metrics["loss"] = np.float32("nan")
+        return state, metrics
+
+    trainer.step_fn = poisoned
+    with pytest.raises(FloatingPointError, match="last good checkpoint"):
+        trainer.train()
+    # corrupted state must NOT have been saved (params absorbed the NaN update)
+    assert trainer.ckpt.all_steps() == []
+    trainer.ckpt.close()  # release orbax's async executor (train() never got to)
