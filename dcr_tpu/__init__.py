@@ -16,3 +16,30 @@ Layering (SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Lazy public API: importing dcr_tpu stays cheap (no jax/orbax cost) until a
+# symbol is actually used.
+_PUBLIC = {
+    "TrainConfig": "dcr_tpu.core.config",
+    "SampleConfig": "dcr_tpu.core.config",
+    "EvalConfig": "dcr_tpu.core.config",
+    "SearchConfig": "dcr_tpu.core.config",
+    "ModelConfig": "dcr_tpu.core.config",
+    "MeshConfig": "dcr_tpu.core.config",
+    "Trainer": "dcr_tpu.diffusion.trainer",
+    "generate": "dcr_tpu.sampling.pipeline",
+    "run_eval": "dcr_tpu.eval.runner",
+    "make_mesh": "dcr_tpu.parallel.mesh",
+}
+
+
+def __getattr__(name):
+    if name in _PUBLIC:
+        import importlib
+
+        return getattr(importlib.import_module(_PUBLIC[name]), name)
+    raise AttributeError(f"module 'dcr_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_PUBLIC))

@@ -94,7 +94,9 @@ class Trainer:
         self.state = T.shard_train_state(self.state, self.mesh)
         self.step_fn = T.make_train_step(cfg, self.models, self.mesh)
         self.train_key = rngmod.stream_key(root, "train")
+        # same wandb project name as the reference trainer (diff_train.py:545)
         self.writer = MetricWriter(self.out_dir / "logs", config=to_dict(cfg),
+                                   wandb_project="diffrep_ft",
                                    run_name=run_name(cfg))
         self.ckpt = CheckpointManager(self.out_dir / "checkpoints",
                                       max_to_keep=cfg.checkpoints_total_limit)
