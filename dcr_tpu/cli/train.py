@@ -18,6 +18,7 @@ def main(argv=None) -> None:
     cfg = parse_cli(TrainConfig, argv)
     # periodic sample grids every save_steps (the reference's visual check)
     trainer = Trainer(cfg, sample_hook=make_sample_hook())
+    trainer.install_preemption_handler()
     metrics = trainer.train()
     logging.getLogger("dcr_tpu").info("training done: %s", metrics)
 

@@ -179,6 +179,7 @@ class TrainConfig:
     save_steps: int = 500                  # sample-image grids
     modelsavesteps: int = 1000             # checkpoints
     log_every: int = 50
+    use_wandb: bool = False                # wandb sink (jsonl/tb always on)
     checkpoints_total_limit: int = 3
     model: ModelConfig = field(default_factory=ModelConfig)
     data: DataConfig = field(default_factory=DataConfig)
@@ -229,6 +230,7 @@ class EvalConfig:
     gallery_max_rank: int = 200
     dup_weights_pickle: str = ""           # training sampling-weights file
     output_dir: str = "ret_plots"
+    use_wandb: bool = False                # wandb sink (jsonl/tb always on)
     seed: int = 42
     mesh: MeshConfig = field(default_factory=MeshConfig)
 

@@ -96,6 +96,7 @@ class Trainer:
         self.train_key = rngmod.stream_key(root, "train")
         # same wandb project name as the reference trainer (diff_train.py:545)
         self.writer = MetricWriter(self.out_dir / "logs", config=to_dict(cfg),
+                                   use_wandb=cfg.use_wandb,
                                    wandb_project="diffrep_ft",
                                    run_name=run_name(cfg))
         self.ckpt = CheckpointManager(self.out_dir / "checkpoints",
@@ -148,6 +149,54 @@ class Trainer:
 
         return flops_of_jitted(self.step_fn, self.state, sharded_batch,
                                self.train_key)
+
+    # -- preemption ----------------------------------------------------------
+
+    def install_preemption_handler(self, signals=None) -> None:
+        """SIGTERM/SIGINT → finish the current step, checkpoint, exit cleanly —
+        what preemptible TPU pods need (SURVEY.md §5.3; the reference has no
+        recovery story at all). Installed by the train CLI; library users
+        opt in explicitly.
+
+        The first signal sets the flag and restores the default disposition, so
+        a second Ctrl-C/TERM aborts immediately (e.g. while stuck in a long
+        compile before any step boundary). Handlers are uninstalled when
+        train() exits. Multi-host: the flag is agreed across processes at the
+        periodic sync point before anyone branches, so one host's signal can't
+        desynchronize the pod's collectives."""
+        import signal as _signal
+
+        self._preempted = False
+        self._preempt_signals = tuple(signals or (_signal.SIGTERM, _signal.SIGINT))
+
+        def handler(signum, frame):
+            log.warning("received signal %d: will checkpoint and stop at the "
+                        "next sync point (send again to abort immediately)",
+                        signum)
+            self._preempted = True
+            _signal.signal(signum, _signal.SIG_DFL)
+
+        for sig in self._preempt_signals:
+            _signal.signal(sig, handler)
+
+    def _uninstall_preemption_handler(self) -> None:
+        import signal as _signal
+
+        for sig in getattr(self, "_preempt_signals", ()):
+            _signal.signal(sig, _signal.SIG_DFL)
+        self._preempt_signals = ()
+
+    def _global_preempted(self) -> bool:
+        """Pod-wide agreement on the preemption flag: any host signaled →
+        every host stops at the same step (a tiny DCN allgather; called at
+        checkpoint/log boundaries, not every step)."""
+        if jax.process_count() == 1:
+            return getattr(self, "_preempted", False)
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([getattr(self, "_preempted", False)]))
+        return bool(np.any(flags))
 
     # -- the loop ------------------------------------------------------------
 
@@ -202,6 +251,23 @@ class Trainer:
                     t_last, imgs_last = time.time(), 0
                 if self.sample_hook and step % cfg.save_steps == 0:
                     self.sample_hook(self, step)
+                # preemption check BEFORE the periodic save so the same step is
+                # never written twice inside the shutdown grace window.
+                # Multi-host: the agreement collective must run on EVERY host or
+                # none, so it happens only at the uniform log_every boundary
+                # (a local flag alone must not start a collective).
+                if jax.process_count() > 1:
+                    check_preempt = step % cfg.log_every == 0
+                else:
+                    check_preempt = getattr(self, "_preempted", False)
+                if check_preempt and self._global_preempted():
+                    log.warning("preemption: checkpointing at step %d and "
+                                "stopping (resume picks up here)", step)
+                    self.save(force=True)
+                    self.ckpt.wait()
+                    self.writer.close()
+                    self._uninstall_preemption_handler()
+                    return last_metrics
                 if step % cfg.modelsavesteps == 0:
                     self.save()
                 if step >= max_steps:
@@ -210,4 +276,5 @@ class Trainer:
         self.ckpt.wait()
         self.export_checkpoint()
         self.writer.close()
+        self._uninstall_preemption_handler()
         return last_metrics

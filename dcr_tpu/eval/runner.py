@@ -134,7 +134,8 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
     mesh = pmesh.make_mesh(cfg.mesh)
     out_dir = Path(cfg.output_dir)
     # same wandb project name as the reference eval (diff_retrieval.py:380)
-    writer = MetricWriter(out_dir / "logs", wandb_project="imsimv2_retrieval")
+    writer = MetricWriter(out_dir / "logs", use_wandb=cfg.use_wandb,
+                          wandb_project="imsimv2_retrieval")
     tokenizer = tokenizer or load_tokenizer(None)
 
     # reference retrieval transform: Resize(256) + CenterCrop(224) +

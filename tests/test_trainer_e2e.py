@@ -139,3 +139,43 @@ def test_nan_guard_checkpoints_and_raises(train_setup, monkeypatch):
     # corrupted state must NOT have been saved (params absorbed the NaN update)
     assert trainer.ckpt.all_steps() == []
     trainer.ckpt.close()  # release orbax's async executor (train() never got to)
+
+
+def test_preemption_checkpoints_and_resumes(train_setup):
+    """Simulated preemption mid-training: checkpoint written, resume continues."""
+    cfg, tmp_path = train_setup
+    cfg.output_dir = str(tmp_path / "run_preempt")
+    cfg.max_train_steps = 6
+    cfg.modelsavesteps = 100
+    trainer = Trainer(cfg)
+    trainer.install_preemption_handler()
+    real_step = trainer.step_fn
+    calls = {"n": 0}
+
+    def step_then_preempt(state, batch, key):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            trainer._preempted = True  # what the signal handler sets
+        return real_step(state, batch, key)
+
+    trainer.step_fn = step_then_preempt
+    trainer.train()
+    assert trainer.ckpt.all_steps() == [2]
+    # resume from the preemption checkpoint
+    trainer2 = Trainer(cfg)
+    assert trainer2.maybe_resume() == 2
+    trainer2.train()
+    assert 6 in trainer2.ckpt.all_steps()
+
+
+def test_config_file_presets_load():
+    from dcr_tpu.core.config import TrainConfig, load_config
+    from pathlib import Path
+
+    repo = Path(__file__).parent.parent
+    smoke = load_config(TrainConfig, repo / "configs" / "smoke_cpu.json")
+    assert smoke.model.sample_size == 8
+    full = load_config(TrainConfig, repo / "configs" / "imagenette_sd21_256.json")
+    assert full.train_batch_size == 16
+    assert full.optim.lr_warmup_steps == 5000
+    assert full.model.block_out_channels == (320, 640, 1280, 1280)
