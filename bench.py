@@ -73,6 +73,29 @@ def bench(batch_size: int, steps: int = 10):
 
 def main():
     import os
+    import sys
+    import threading
+
+    # watchdog: the tunneled-TPU backend can wedge so hard that jax.devices()
+    # blocks forever (observed in round 1); fail loudly instead of hanging the
+    # driver. The deadline is re-armed per ladder attempt (each retry pays a
+    # full recompile). BENCH_TIMEOUT_SECS<=0 disables it.
+    try:
+        timeout_s = float(os.environ.get("BENCH_TIMEOUT_SECS") or 2400)
+    except ValueError:
+        timeout_s = 2400.0
+    deadline = [time.monotonic() + timeout_s]
+
+    def watchdog():
+        while time.monotonic() < deadline[0]:
+            time.sleep(min(10.0, max(0.1, deadline[0] - time.monotonic())))
+        print(f"bench: exceeded {timeout_s:.0f}s since the last attempt "
+              "(backend hang or runaway compile); aborting",
+              file=sys.stderr, flush=True)
+        os._exit(3)
+
+    if timeout_s > 0:
+        threading.Thread(target=watchdog, daemon=True).start()
 
     value = None
     err = None
@@ -80,6 +103,7 @@ def main():
     if os.environ.get("BENCH_BS"):
         ladder = (int(os.environ["BENCH_BS"]),)
     for bs in ladder:
+        deadline[0] = time.monotonic() + timeout_s  # re-arm per attempt
         try:
             value = bench(bs)
             break
