@@ -188,11 +188,18 @@ class Transformer2D(nn.Module):
 class Downsample2D(nn.Module):
     out_channels: int
     dtype: jnp.dtype = jnp.float32
+    # diffusers' AutoencoderKL encoder downsamples with padding=0 plus an
+    # asymmetric (0,1,0,1) pre-pad (right/bottom only); the UNet downsampler
+    # uses symmetric padding=1. Both produce the same output shape for even
+    # inputs but sample different taps, so pretrained VAE weights require the
+    # asymmetric variant to reproduce reference activations.
+    asymmetric_pad: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        pad = ((0, 1), (0, 1)) if self.asymmetric_pad else ((1, 1), (1, 1))
         return nn.Conv(self.out_channels, (3, 3), strides=(2, 2),
-                       padding=((1, 1), (1, 1)), dtype=self.dtype, name="conv")(x)
+                       padding=pad, dtype=self.dtype, name="conv")(x)
 
 
 class Upsample2D(nn.Module):

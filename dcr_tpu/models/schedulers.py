@@ -137,15 +137,34 @@ def pred_to_x0_eps(sched: NoiseSchedule, model_out: jax.Array, x_t: jax.Array,
 # Inference-time timestep grids
 # ---------------------------------------------------------------------------
 
-def inference_timesteps(sched: NoiseSchedule, num_inference_steps: int) -> jax.Array:
-    """Descending timestep grid [num_inference_steps], diffusers 'leading' spacing."""
-    if num_inference_steps > sched.num_train_timesteps:
+def inference_timesteps(sched: NoiseSchedule, num_inference_steps: int,
+                        spacing: str = "leading", steps_offset: int = 1) -> jax.Array:
+    """Descending timestep grid [num_inference_steps].
+
+    Mirrors diffusers' ``set_timesteps`` grids so sampled trajectories are
+    comparable to the reference pipeline (diff_inference.py:93):
+
+    - ``"leading"``: DDIM/PNDM-family. ``steps_offset`` (1 in SD's shipped
+      scheduler configs) shifts the whole grid up by one training timestep;
+      clipped to num_train_timesteps-1.
+    - ``"linspace"``: DPMSolverMultistep's default — n+1 evenly spaced points
+      over [0, T-1], reversed, last dropped. ``steps_offset`` is unused here,
+      matching diffusers.
+    """
+    T = sched.num_train_timesteps
+    if num_inference_steps > T:
         raise ValueError(
             f"num_inference_steps={num_inference_steps} exceeds "
-            f"num_train_timesteps={sched.num_train_timesteps}")
-    step = sched.num_train_timesteps // num_inference_steps
-    ts = (np.arange(num_inference_steps) * step).round()[::-1].copy().astype(np.int32)
-    return jnp.asarray(ts)
+            f"num_train_timesteps={T}")
+    if spacing == "leading":
+        step = T // num_inference_steps
+        ts = (np.arange(num_inference_steps) * step).round()[::-1].copy()
+        ts = np.minimum(ts + steps_offset, T - 1)
+    elif spacing == "linspace":
+        ts = np.linspace(0, T - 1, num_inference_steps + 1).round()[::-1][:-1].copy()
+    else:
+        raise ValueError(f"unknown timestep spacing {spacing!r}")
+    return jnp.asarray(ts.astype(np.int32))
 
 
 # ---------------------------------------------------------------------------
@@ -205,13 +224,16 @@ def _lambda_of(sched: NoiseSchedule, t: jax.Array) -> jax.Array:
 
 
 def dpmpp_2m_step(sched: NoiseSchedule, model_out: jax.Array, x_t: jax.Array,
-                  t: jax.Array, prev_t: jax.Array,
-                  state: DPMState) -> tuple[jax.Array, DPMState]:
+                  t: jax.Array, prev_t: jax.Array, state: DPMState,
+                  force_first_order: jax.Array | bool = False) -> tuple[jax.Array, DPMState]:
     """One DPM-Solver++(2M) update x_t -> x_{prev_t}; t/prev_t scalar or [B].
 
     First call (state.step_index == 0) falls back to the first-order (DDIM-like)
     update; later calls use the 2nd-order multistep correction. With batched t,
     initialize the state via ``dpm_init_state(x.shape, batch_shape=t.shape)``.
+
+    ``force_first_order`` mirrors diffusers' ``lower_order_final``: the caller
+    sets it on the final step of short (<15-step) trajectories for stability.
     """
     nd = x_t.ndim
     x0, _eps = pred_to_x0_eps(sched, model_out, x_t, t)
@@ -234,7 +256,9 @@ def dpmpp_2m_step(sched: NoiseSchedule, model_out: jax.Array, x_t: jax.Array,
     h_last = lam_t - state.prev_lambda
     r = h_last / jnp.where(h == 0, 1e-20, h)
     inv2r = _bcast(1.0 / (2.0 * jnp.maximum(r, 1e-20)), nd)
-    d = jnp.where(state.step_index > 0, (1.0 + inv2r) * x0 - inv2r * state.prev_x0, x0)
+    use_second = jnp.logical_and(state.step_index > 0,
+                                 jnp.logical_not(force_first_order))
+    d = jnp.where(use_second, (1.0 + inv2r) * x0 - inv2r * state.prev_x0, x0)
 
     x_prev = ratio * x_t - _bcast(alpha_s, nd) * phi * d
     new_state = DPMState(prev_x0=x0,

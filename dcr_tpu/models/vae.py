@@ -46,7 +46,10 @@ class Encoder(nn.Module):
                 h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype,
                                     name=f"down_{i}_res_{j}")(h)
             if i < len(block_out) - 1:
-                h = L.Downsample2D(ch, dtype=self.dtype, name=f"down_{i}_downsample")(h)
+                # asymmetric (0,1,0,1) pad + VALID conv, matching diffusers'
+                # AutoencoderKL encoder (Downsample2D with padding=0).
+                h = L.Downsample2D(ch, asymmetric_pad=True, dtype=self.dtype,
+                                   name=f"down_{i}_downsample")(h)
         ch = block_out[-1]
         h = L.ResnetBlock2D(ch, num_groups=groups, epsilon=1e-6, dtype=self.dtype, name="mid_res_0")(h)
         h = L.AttentionBlock2D(num_groups=groups, dtype=self.dtype, name="mid_attn")(h)

@@ -41,6 +41,29 @@ def encode_prompts(models: DiffusionModels, text_params, input_ids: jax.Array,
     return cond, uncond
 
 
+def sampler_grid(sampler: str, sched, num_inference_steps: int):
+    """(ts, prev_ts, lower_order_final) for a sampler name — the single source
+    of the per-sampler diffusers-parity wiring, tested directly against the
+    reference fixture in tests/test_scheduler_parity.py.
+
+    - spacing follows the diffusers scheduler each sampler maps to: linspace
+      for DPMSolverMultistep, leading for DDIM/DDPM;
+    - steps_offset=1 is the SD scheduler-config value (DDIM/PNDM family);
+      diffusers' DDPMScheduler uses no offset;
+    - final-step target: DPMSolverMultistep steps to t=0, and SD's DDIM config
+      has set_alpha_to_one=False (final acp = alphas_cumprod[0]) — both are our
+      prev_t=0. DDPM's terminal variance uses acp=1 (prev_t=-1);
+    - lower_order_final mirrors diffusers: first-order final step when <15 steps.
+    """
+    spacing = "linspace" if sampler == "dpm++" else "leading"
+    offset = 0 if sampler == "ddpm" else 1
+    ts = S.inference_timesteps(sched, num_inference_steps, spacing=spacing,
+                               steps_offset=offset)
+    final_prev = -1 if sampler == "ddpm" else 0
+    prev_ts = jnp.concatenate([ts[1:], jnp.array([final_prev], ts.dtype)])
+    return ts, prev_ts, num_inference_steps < 15
+
+
 def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     """Build the jitted sampler: (params, input_ids, uncond_ids, key) -> images.
 
@@ -53,9 +76,9 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     guidance = cfg.guidance_scale
     batch_spec = pmesh.batch_sharding(mesh)
 
-    # host-precomputed timestep grid [T] plus prev grid
-    ts = S.inference_timesteps(sched, cfg.num_inference_steps)
-    prev_ts = jnp.concatenate([ts[1:], jnp.array([-1], ts.dtype)])
+    # host-precomputed timestep grid [T] plus prev grid (see sampler_grid)
+    ts, prev_ts, lower_order_final = sampler_grid(cfg.sampler, sched,
+                                                  cfg.num_inference_steps)
 
     def sample_fn(params, input_ids, uncond_ids, key):
         input_ids = jax.lax.with_sharding_constraint(input_ids, batch_spec)
@@ -81,7 +104,10 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
                 x_new = S.ddim_step(sched, pred, x, t, prev_t)
                 dpm_new = dpm_state
             elif cfg.sampler == "dpm++":
-                x_new, dpm_new = S.dpmpp_2m_step(sched, pred, x, t, prev_t, dpm_state)
+                force1 = jnp.logical_and(lower_order_final,
+                                         step_idx == len(ts) - 1)
+                x_new, dpm_new = S.dpmpp_2m_step(sched, pred, x, t, prev_t,
+                                                 dpm_state, force_first_order=force1)
             elif cfg.sampler == "ddpm":
                 x_new = S.ddpm_step(sched, pred, x, t, prev_t,
                                     jax.random.fold_in(ks, step_idx))

@@ -1,0 +1,158 @@
+"""Independent NumPy transcription of the diffusers scheduler step semantics.
+
+The build environment has no diffusers install and zero egress, so true
+record-and-replay against the reference pipeline (diff_inference.py:93) is not
+possible here. This module is the next-best evidence: a from-scratch NumPy
+implementation of the *published* algorithms — DDIM (Song et al. 2020, eq. 12)
+and DPM-Solver++(2M) (Lu et al. 2022, §4) — carrying the diffusers-specific
+bookkeeping the SD pipelines layer on top (``set_timesteps`` spacing grids,
+``steps_offset=1``, ``set_alpha_to_one=False``, final-step target t=0,
+``lower_order_final``). It is written as stateful per-step classes mirroring
+how the torch pipeline consumes a scheduler, shares no code with
+``dcr_tpu.models.schedulers``, and works in float64 — so the test comparing the
+two is a comparison of independently derived trajectories, not a self-golden.
+
+If a diffusers install ever becomes available, `record_fixture.py`-style dumps
+should replace this module as the source of truth.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _make_betas(num_train_timesteps: int, beta_schedule: str,
+                beta_start: float, beta_end: float) -> np.ndarray:
+    if beta_schedule == "linear":
+        return np.linspace(beta_start, beta_end, num_train_timesteps, dtype=np.float64)
+    if beta_schedule == "scaled_linear":
+        return np.linspace(beta_start ** 0.5, beta_end ** 0.5,
+                           num_train_timesteps, dtype=np.float64) ** 2
+    raise ValueError(beta_schedule)
+
+
+class RefDDIMScheduler:
+    """diffusers.DDIMScheduler semantics, eta=0, no thresholding/clipping
+    (the SD pipeline configuration)."""
+
+    def __init__(self, num_train_timesteps: int = 1000,
+                 beta_schedule: str = "scaled_linear",
+                 beta_start: float = 0.00085, beta_end: float = 0.012,
+                 prediction_type: str = "epsilon",
+                 steps_offset: int = 1, set_alpha_to_one: bool = False):
+        self.num_train_timesteps = num_train_timesteps
+        self.prediction_type = prediction_type
+        self.steps_offset = steps_offset
+        betas = _make_betas(num_train_timesteps, beta_schedule, beta_start, beta_end)
+        self.alphas_cumprod = np.cumprod(1.0 - betas)
+        self.final_alpha_cumprod = 1.0 if set_alpha_to_one else self.alphas_cumprod[0]
+        self.timesteps: np.ndarray | None = None
+        self.num_inference_steps: int | None = None
+
+    def set_timesteps(self, num_inference_steps: int) -> None:
+        # "leading" spacing + steps_offset, as in SD's shipped configs
+        self.num_inference_steps = num_inference_steps
+        step_ratio = self.num_train_timesteps // num_inference_steps
+        ts = (np.arange(0, num_inference_steps) * step_ratio).round()[::-1].copy()
+        self.timesteps = (ts + self.steps_offset).astype(np.int64)
+
+    def _x0_eps(self, model_output, sample, t):
+        acp = self.alphas_cumprod[t]
+        a, s = np.sqrt(acp), np.sqrt(1.0 - acp)
+        if self.prediction_type == "epsilon":
+            eps = model_output
+            x0 = (sample - s * eps) / a
+        elif self.prediction_type == "v_prediction":
+            x0 = a * sample - s * model_output
+            eps = a * model_output + s * sample
+        else:
+            raise ValueError(self.prediction_type)
+        return x0, eps
+
+    def step(self, model_output: np.ndarray, timestep: int,
+             sample: np.ndarray) -> np.ndarray:
+        prev_t = timestep - self.num_train_timesteps // self.num_inference_steps
+        x0, eps = self._x0_eps(model_output, sample, timestep)
+        acp_prev = (self.alphas_cumprod[prev_t] if prev_t >= 0
+                    else self.final_alpha_cumprod)
+        direction = np.sqrt(1.0 - acp_prev) * eps  # eta = 0
+        return np.sqrt(acp_prev) * x0 + direction
+
+
+class RefDPMSolverMultistepScheduler:
+    """diffusers.DPMSolverMultistepScheduler semantics: algorithm dpmsolver++,
+    solver_order=2, solver_type=midpoint, lower_order_final=True, no
+    thresholding — the configuration diff_inference.py:93 runs stock SD with."""
+
+    def __init__(self, num_train_timesteps: int = 1000,
+                 beta_schedule: str = "scaled_linear",
+                 beta_start: float = 0.00085, beta_end: float = 0.012,
+                 prediction_type: str = "epsilon",
+                 lower_order_final: bool = True):
+        self.num_train_timesteps = num_train_timesteps
+        self.prediction_type = prediction_type
+        self.lower_order_final = lower_order_final
+        betas = _make_betas(num_train_timesteps, beta_schedule, beta_start, beta_end)
+        self.alphas_cumprod = np.cumprod(1.0 - betas)
+        self.alpha_t = np.sqrt(self.alphas_cumprod)
+        self.sigma_t = np.sqrt(1.0 - self.alphas_cumprod)
+        self.lambda_t = np.log(self.alpha_t) - np.log(self.sigma_t)
+        self.timesteps: np.ndarray | None = None
+        self._model_outputs: list[np.ndarray] = []
+        self._timestep_list: list[int] = []
+        self._lower_order_nums = 0
+
+    def set_timesteps(self, num_inference_steps: int) -> None:
+        # "linspace" spacing: n+1 points over [0, T-1], reversed, last dropped
+        ts = np.linspace(0, self.num_train_timesteps - 1,
+                         num_inference_steps + 1).round()[::-1][:-1].copy()
+        self.timesteps = ts.astype(np.int64)
+        self._model_outputs = []
+        self._timestep_list = []
+        self._lower_order_nums = 0
+
+    def _convert_model_output(self, model_output, sample, t):
+        # dpmsolver++ works on x0 predictions
+        a, s = self.alpha_t[t], self.sigma_t[t]
+        if self.prediction_type == "epsilon":
+            return (sample - s * model_output) / a
+        if self.prediction_type == "v_prediction":
+            return a * sample - s * model_output
+        raise ValueError(self.prediction_type)
+
+    def _first_order_update(self, m0, t, prev_t, sample):
+        lam_t, lam_s = self.lambda_t[prev_t], self.lambda_t[t]
+        h = lam_t - lam_s
+        return (self.sigma_t[prev_t] / self.sigma_t[t]) * sample \
+            - self.alpha_t[prev_t] * (np.exp(-h) - 1.0) * m0
+
+    def _second_order_update(self, prev_t, sample):
+        t = prev_t
+        s0, s1 = self._timestep_list[-1], self._timestep_list[-2]
+        m0, m1 = self._model_outputs[-1], self._model_outputs[-2]
+        lam_t, lam_s0, lam_s1 = self.lambda_t[t], self.lambda_t[s0], self.lambda_t[s1]
+        h, h_0 = lam_t - lam_s0, lam_s0 - lam_s1
+        r0 = h_0 / h
+        D0, D1 = m0, (1.0 / r0) * (m0 - m1)
+        # midpoint rule
+        return (self.sigma_t[t] / self.sigma_t[s0]) * sample \
+            - self.alpha_t[t] * (np.exp(-h) - 1.0) * D0 \
+            - 0.5 * self.alpha_t[t] * (np.exp(-h) - 1.0) * D1
+
+    def step(self, model_output: np.ndarray, timestep: int,
+             sample: np.ndarray) -> np.ndarray:
+        step_index = int(np.where(self.timesteps == timestep)[0][0])
+        prev_t = (0 if step_index == len(self.timesteps) - 1
+                  else int(self.timesteps[step_index + 1]))
+        final_first = (step_index == len(self.timesteps) - 1
+                       and self.lower_order_final and len(self.timesteps) < 15)
+        x0 = self._convert_model_output(model_output, sample, timestep)
+        self._model_outputs = (self._model_outputs + [x0])[-2:]
+        self._timestep_list = (self._timestep_list + [int(timestep)])[-2:]
+        if self._lower_order_nums < 1 or final_first:
+            out = self._first_order_update(x0, int(timestep), prev_t, sample)
+        else:
+            out = self._second_order_update(prev_t, sample)
+        if self._lower_order_nums < 2:
+            self._lower_order_nums += 1
+        return out

@@ -286,3 +286,28 @@ def test_linear_numeric_parity_with_torch():
         ref = lin(x).numpy()
     out = x.numpy() @ CV.linear_kernel(lin.weight.detach().numpy()) + lin.bias.detach().numpy()
     np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_vae_downsample_asymmetric_pad_parity_with_torch():
+    """The VAE encoder downsampler must reproduce diffusers' AutoencoderKL
+    behavior: F.pad(x, (0,1,0,1)) then Conv2d(stride=2, padding=0). Verified
+    against real torch ops (ADVICE round-1: symmetric padding silently shifts
+    encoder activations under pretrained weights)."""
+    torch = pytest.importorskip("torch")
+
+    from dcr_tpu.models.layers import Downsample2D
+
+    torch.manual_seed(2)
+    conv = torch.nn.Conv2d(3, 5, 3, stride=2, padding=0, bias=True).eval()
+    x = torch.randn(2, 3, 16, 16)
+    with torch.no_grad():
+        ref = conv(torch.nn.functional.pad(x, (0, 1, 0, 1)))
+    ref = ref.numpy().transpose(0, 2, 3, 1)
+
+    params = {"conv": {"kernel": CV.conv_kernel(conv.weight.detach().numpy()),
+                       "bias": conv.bias.detach().numpy()}}
+    out = Downsample2D(5, asymmetric_pad=True).apply(
+        {"params": jax.tree.map(jnp.asarray, params)},
+        jnp.asarray(x.numpy().transpose(0, 2, 3, 1)))
+    assert out.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(out), ref, atol=1e-5, rtol=1e-5)

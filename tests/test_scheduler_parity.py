@@ -1,0 +1,166 @@
+"""Cross-framework sampler parity: dcr_tpu schedulers vs an independent NumPy
+transcription of the diffusers step semantics (tests/fixtures/
+reference_schedulers.py). Covers VERDICT round-1 item 6: trajectory-level
+evidence that our DDIM / DPM-Solver++(2M) step math matches the reference
+pipeline's scheduler (diff_inference.py:93), not just self-consistency.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dcr_tpu.models import schedulers as S
+from tests.fixtures.reference_schedulers import (
+    RefDDIMScheduler,
+    RefDPMSolverMultistepScheduler,
+)
+
+SHAPE = (1, 4, 4, 2)
+
+
+def _fake_model(prediction_type: str):
+    """Deterministic stand-in for the UNet: shape-preserving, t-dependent,
+    identical bits on both sides (defined in float64 numpy)."""
+    rs = np.random.RandomState(0)
+    field = rs.randn(*SHAPE)
+
+    def fn(x: np.ndarray, t: int) -> np.ndarray:
+        return 0.3 * x + np.sin(t / 100.0) * field + 0.05
+
+    return fn
+
+
+def _init_latent():
+    return np.random.RandomState(1).randn(*SHAPE)
+
+
+def _run_ours_ddim(n_steps, prediction_type, model):
+    s = S.make_schedule(prediction_type=prediction_type)
+    ts = np.asarray(S.inference_timesteps(s, n_steps, spacing="leading"))
+    # final prev_t=0 == diffusers set_alpha_to_one=False (sampler.py contract)
+    prev = np.concatenate([ts[1:], [0]]).astype(np.int32)
+    x = jnp.asarray(_init_latent(), jnp.float32)
+    for i, t in enumerate(ts):
+        out = jnp.asarray(model(np.asarray(x, np.float64), int(t)), jnp.float32)
+        x = S.ddim_step(s, out, x, jnp.asarray(int(t)), jnp.asarray(int(prev[i])))
+    return np.asarray(x)
+
+
+def _run_ref_ddim(n_steps, prediction_type, model):
+    ref = RefDDIMScheduler(prediction_type=prediction_type)
+    ref.set_timesteps(n_steps)
+    x = _init_latent()
+    for t in ref.timesteps:
+        x = ref.step(model(x, int(t)), int(t), x)
+    return x
+
+
+def _run_ours_dpm(n_steps, prediction_type, model):
+    s = S.make_schedule(prediction_type=prediction_type)
+    ts = np.asarray(S.inference_timesteps(s, n_steps, spacing="linspace"))
+    prev = np.concatenate([ts[1:], [0]]).astype(np.int32)
+    x = jnp.asarray(_init_latent(), jnp.float32)
+    state = S.dpm_init_state(SHAPE)
+    for i, t in enumerate(ts):
+        out = jnp.asarray(model(np.asarray(x, np.float64), int(t)), jnp.float32)
+        force1 = (n_steps < 15) and i == len(ts) - 1
+        x, state = S.dpmpp_2m_step(s, out, x, jnp.asarray(int(t)),
+                                   jnp.asarray(int(prev[i])), state,
+                                   force_first_order=force1)
+    return np.asarray(x)
+
+
+def _run_ref_dpm(n_steps, prediction_type, model):
+    ref = RefDPMSolverMultistepScheduler(prediction_type=prediction_type)
+    ref.set_timesteps(n_steps)
+    x = _init_latent()
+    for t in ref.timesteps:
+        x = ref.step(model(x, int(t)), int(t), x)
+    return x
+
+
+def test_timestep_grid_parity_leading():
+    s = S.make_schedule()
+    ref = RefDDIMScheduler()
+    for n in (5, 10, 50):
+        ref.set_timesteps(n)
+        ours = np.asarray(S.inference_timesteps(s, n, spacing="leading"))
+        np.testing.assert_array_equal(ours, ref.timesteps)
+
+
+def test_timestep_grid_parity_linspace():
+    s = S.make_schedule()
+    ref = RefDPMSolverMultistepScheduler()
+    for n in (5, 20, 50):
+        ref.set_timesteps(n)
+        ours = np.asarray(S.inference_timesteps(s, n, spacing="linspace"))
+        np.testing.assert_array_equal(ours, ref.timesteps)
+
+
+def test_ddim_trajectory_matches_reference_eps():
+    model = _fake_model("epsilon")
+    ours = _run_ours_ddim(5, "epsilon", model)
+    ref = _run_ref_ddim(5, "epsilon", model)
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_ddim_trajectory_matches_reference_vpred():
+    model = _fake_model("v_prediction")
+    ours = _run_ours_ddim(5, "v_prediction", model)
+    ref = _run_ref_ddim(5, "v_prediction", model)
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_dpmpp_trajectory_matches_reference_short():
+    """5 steps: exercises first-order bootstrap AND lower_order_final."""
+    model = _fake_model("epsilon")
+    ours = _run_ours_dpm(5, "epsilon", model)
+    ref = _run_ref_dpm(5, "epsilon", model)
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_dpmpp_trajectory_matches_reference_long():
+    """20 steps (>=15): pure 2M multistep path, no lower_order_final."""
+    model = _fake_model("epsilon")
+    ours = _run_ours_dpm(20, "epsilon", model)
+    ref = _run_ref_dpm(20, "epsilon", model)
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_ddpm_grid_has_no_offset():
+    """diffusers' DDPMScheduler applies no steps_offset (unlike DDIM/PNDM)."""
+    s = S.make_schedule()
+    ours = np.asarray(S.inference_timesteps(s, 50, spacing="leading",
+                                            steps_offset=0))
+    expected = (np.arange(50) * 20).round()[::-1].astype(np.int64)
+    np.testing.assert_array_equal(ours, expected)
+
+
+def test_sampler_grid_production_mapping():
+    """The production per-sampler wiring (sampler_grid) — not a re-derivation —
+    must match the reference fixture grids and final-step targets."""
+    from dcr_tpu.sampling.sampler import sampler_grid
+
+    s = S.make_schedule()
+    ref_dpm = RefDPMSolverMultistepScheduler()
+    ref_dpm.set_timesteps(5)
+    ts, prev, lof = sampler_grid("dpm++", s, 5)
+    np.testing.assert_array_equal(np.asarray(ts), ref_dpm.timesteps)
+    assert int(prev[-1]) == 0 and lof  # t=0 final target, lower_order_final
+
+    ref_ddim = RefDDIMScheduler()
+    ref_ddim.set_timesteps(50)
+    ts, prev, lof = sampler_grid("ddim", s, 50)
+    np.testing.assert_array_equal(np.asarray(ts), ref_ddim.timesteps)
+    assert int(prev[-1]) == 0 and not lof
+
+    ts, prev, _ = sampler_grid("ddpm", s, 50)
+    assert int(ts[-1]) == 0 and int(prev[-1]) == -1  # no offset; acp=1 terminal
+
+
+def test_dpmpp_trajectory_matches_reference_vpred():
+    """SD 2.1 actually runs v_prediction through DPMSolverMultistep."""
+    model = _fake_model("v_prediction")
+    ours = _run_ours_dpm(5, "v_prediction", model)
+    ref = _run_ref_dpm(5, "v_prediction", model)
+    np.testing.assert_allclose(ours, ref, atol=2e-3, rtol=2e-3)
