@@ -73,13 +73,99 @@ class CheckpointManager:
 # HF-layout export/import (diffusers directory-of-subfolders convention)
 # ---------------------------------------------------------------------------
 
+def _diffusers_configs(mc: dict) -> dict[str, dict]:
+    """Per-subfolder diffusers/transformers config.json contents derived from
+    our ModelConfig dict (mirrors stabilityai/stable-diffusion-2-1's shipped
+    configs at the default dims)."""
+    ch = list(mc.get("block_out_channels", (320, 640, 1280, 1280)))
+    head_dim = mc.get("attention_head_dim", 64)
+    n = len(ch)
+    unet = {
+        "_class_name": "UNet2DConditionModel",
+        "_diffusers_version": "0.14.0",
+        "sample_size": mc.get("sample_size", 32),
+        "in_channels": mc.get("in_channels", 4),
+        "out_channels": mc.get("out_channels", 4),
+        "down_block_types": ["CrossAttnDownBlock2D"] * (n - 1) + ["DownBlock2D"],
+        "up_block_types": ["UpBlock2D"] + ["CrossAttnUpBlock2D"] * (n - 1),
+        "block_out_channels": ch,
+        "layers_per_block": mc.get("layers_per_block", 2),
+        "cross_attention_dim": mc.get("cross_attention_dim", 1024),
+        # diffusers' (misnamed) per-block heads list: C // head_dim
+        "attention_head_dim": [c // head_dim for c in ch],
+        "use_linear_projection": True,
+        "norm_num_groups": mc.get("norm_num_groups", 32),
+        "act_fn": "silu",
+        "center_input_sample": False,
+        "downsample_padding": 1,
+        "flip_sin_to_cos": True,
+        "freq_shift": 0,
+        "mid_block_scale_factor": 1,
+        "norm_eps": 1e-5,
+    }
+    vch = list(mc.get("vae_block_out_channels", (128, 256, 512, 512)))
+    vae = {
+        "_class_name": "AutoencoderKL",
+        "_diffusers_version": "0.14.0",
+        "sample_size": mc.get("sample_size", 32) * 8,
+        "in_channels": 3,
+        "out_channels": 3,
+        "down_block_types": ["DownEncoderBlock2D"] * len(vch),
+        "up_block_types": ["UpDecoderBlock2D"] * len(vch),
+        "block_out_channels": vch,
+        "latent_channels": mc.get("vae_latent_channels", 4),
+        "layers_per_block": mc.get("vae_layers_per_block", 2),
+        # mirror the model: groups never exceed the narrowest channel count
+        "norm_num_groups": min(mc.get("norm_num_groups", 32), vch[0]),
+        "act_fn": "silu",
+        "scaling_factor": mc.get("vae_scaling_factor", 0.18215),
+    }
+    text = {
+        "architectures": ["CLIPTextModel"],
+        "model_type": "clip_text_model",
+        "vocab_size": mc.get("text_vocab_size", 49408),
+        "hidden_size": mc.get("text_hidden_size", 1024),
+        "intermediate_size": 4 * mc.get("text_hidden_size", 1024),
+        "num_hidden_layers": mc.get("text_layers", 23),
+        "num_attention_heads": mc.get("text_heads", 16),
+        "max_position_embeddings": mc.get("text_max_length", 77),
+        "hidden_act": mc.get("text_act", "gelu"),
+        "layer_norm_eps": 1e-5,
+        "torch_dtype": "float32",
+    }
+    return {"unet": unet, "vae": vae, "text_encoder": text}
+
+
 def export_hf_layout(out_dir: str | Path, *, unet=None, vae=None, text_encoder=None,
                      scheduler_config: Optional[dict] = None,
                      model_config: Optional[dict] = None) -> None:
     """Write checkpoint/<component>/ dirs mirroring the reference's pipeline
-    save format (diff_train.py:709-716), with params as .npz + config.json.
-    Interop is at the directory/naming level; tensors are our NHWC layout."""
+    save format (diff_train.py:709-716).
+
+    Each subfolder gets BOTH:
+      - params.npz — our Flax/NHWC tree, the fast internal path
+        (import_hf_layout reads this back);
+      - diffusion_pytorch_model.safetensors / model.safetensors — real torch
+        layout under exact diffusers/transformers naming (models/export.py),
+        plus a config.json, so diffusers' UNet2DConditionModel.from_pretrained
+        / AutoencoderKL.from_pretrained / transformers'
+        CLIPTextModel.from_pretrained load the export directly. Key sets are
+        manifest-validated (tests/test_export.py).
+    """
+    from dcr_tpu.models import export as EX
+
     out = Path(out_dir)
+    mc = dict(model_config or {})
+    configs = _diffusers_configs(mc)
+    n_blocks = len(mc.get("block_out_channels", (320, 640, 1280, 1280)))
+    to_torch = {
+        "unet": lambda p: EX.unet_to_diffusers(p, n_blocks=n_blocks),
+        "vae": EX.vae_to_diffusers,
+        "text_encoder": EX.text_to_transformers,
+    }
+    st_name = {"unet": "diffusion_pytorch_model.safetensors",
+               "vae": "diffusion_pytorch_model.safetensors",
+               "text_encoder": "model.safetensors"}
     for name, params in (("unet", unet), ("vae", vae), ("text_encoder", text_encoder)):
         if params is None:
             continue
@@ -87,12 +173,44 @@ def export_hf_layout(out_dir: str | Path, *, unet=None, vae=None, text_encoder=N
         sub.mkdir(parents=True, exist_ok=True)
         flat = _flatten(params)
         np.savez(sub / "params.npz", **flat)
+        try:
+            from safetensors.numpy import save_file
+        except ImportError as e:  # pragma: no cover - safetensors is baked in
+            log.warning("torch-layout export for %s skipped: %r", name, e)
+            continue
+        # conversion errors are NOT caught: a key/shape drift must fail the
+        # export loudly, not ship a checkpoint that silently lost interop
+        sd = to_torch[name](params)
+        save_file({k: np.ascontiguousarray(v) for k, v in sd.items()},
+                  str(sub / st_name[name]))
+        (sub / "config.json").write_text(json.dumps(configs[name], indent=2))
     if scheduler_config is not None:
         sub = out / "scheduler"
         sub.mkdir(parents=True, exist_ok=True)
-        (sub / "scheduler_config.json").write_text(json.dumps(scheduler_config, indent=2))
+        sched = {
+            "_class_name": "DPMSolverMultistepScheduler",
+            "_diffusers_version": "0.14.0",
+            "algorithm_type": "dpmsolver++",
+            "solver_order": 2,
+            "solver_type": "midpoint",
+            "lower_order_final": True,
+            "steps_offset": 1,
+            "thresholding": False,
+            "trained_betas": None,
+            **scheduler_config,
+        }
+        (sub / "scheduler_config.json").write_text(json.dumps(sched, indent=2))
     if model_config is not None:
-        (out / "model_index.json").write_text(json.dumps(model_config, indent=2))
+        index = {
+            "_class_name": "StableDiffusionPipeline",
+            "_diffusers_version": "0.14.0",
+            "unet": ["diffusers", "UNet2DConditionModel"],
+            "vae": ["diffusers", "AutoencoderKL"],
+            "text_encoder": ["transformers", "CLIPTextModel"],
+            "scheduler": ["diffusers", "DPMSolverMultistepScheduler"],
+            "model_config": model_config,     # our native config, round-trips
+        }
+        (out / "model_index.json").write_text(json.dumps(index, indent=2))
 
 
 def import_hf_layout(ckpt_dir: str | Path, component: str) -> dict:

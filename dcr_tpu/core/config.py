@@ -84,6 +84,11 @@ class ModelConfig:
     transformer_layers: int = 1
     norm_num_groups: int = 32
     flash_attention: bool = True       # Pallas kernel when on TPU, XLA fallback otherwise
+    # Spatial self-attention switches to ring attention (K/V rotating over the
+    # mesh's `seq` axis, ops/ring_attention.py) when the token count reaches
+    # this AND the mesh's seq axis is >1. 4096 = 512px latents, where the S×S
+    # weight tensor stops fitting comfortably on one chip.
+    seq_parallel_min_seq: int = 4096
     # VAE
     vae_block_out_channels: tuple[int, ...] = (128, 256, 512, 512)
     vae_layers_per_block: int = 2
@@ -95,6 +100,11 @@ class ModelConfig:
     text_layers: int = 23
     text_heads: int = 16
     text_max_length: int = 77
+    # MLP activation of the text tower: SD-2.x's OpenCLIP ViT-H tower uses
+    # exact GELU (HF text_encoder config hidden_act="gelu"); OpenAI CLIP-B/L
+    # towers use quick_gelu (x·σ(1.702x)). Getting this wrong silently drifts
+    # every activation when real weights are loaded.
+    text_act: str = "gelu"
     # diffusion process
     num_train_timesteps: int = 1000
     beta_schedule: str = "scaled_linear"

@@ -36,11 +36,13 @@ from dcr_tpu.parallel import mesh as pmesh
 log = logging.getLogger("dcr_tpu")
 
 
-def build_models(cfg: TrainConfig, key: jax.Array):
+def build_models(cfg: TrainConfig, key: jax.Array, mesh=None):
     """Initialize the module bundle + params (random init; finetuning loads a
-    converted checkpoint over these via models/convert.py)."""
+    converted checkpoint over these via models/convert.py). Passing the mesh
+    enables ring-attention sequence parallelism in the UNet when its seq axis
+    is >1 (cfg.model.seq_parallel_min_seq)."""
     ku, kv, kt = jax.random.split(key, 3)
-    unet, unet_params = init_unet(cfg.model, ku)
+    unet, unet_params = init_unet(cfg.model, ku, mesh=mesh)
     vae, vae_params = init_vae(cfg.model, kv)
     text, text_params = init_clip_text(cfg.model, kt)
     sched = S.make_schedule(
@@ -85,7 +87,8 @@ class Trainer:
             num_workers=cfg.data.num_workers, seed=cfg.data.seed,
             process_index=dist.process_index(), process_count=dist.process_count())
         root = rngmod.root_key(cfg.seed)
-        self.models, params = build_models(cfg, rngmod.stream_key(root, "init"))
+        self.models, params = build_models(cfg, rngmod.stream_key(root, "init"),
+                                           mesh=self.mesh)
         if pretrained_params:
             params.update(pretrained_params)
         self.state = T.init_train_state(
@@ -204,24 +207,41 @@ class Trainer:
         cfg = self.cfg
         start_step = self.maybe_resume()
         steps_per_epoch = self.loader.steps_per_epoch()
-        max_steps = min(cfg.max_train_steps, cfg.num_train_epochs * steps_per_epoch)
+        # All periodic cadences (log_every / save_steps / modelsavesteps /
+        # max_train_steps) count SYNC steps — completed optimizer updates —
+        # matching the reference's accelerate global_step semantics
+        # (diff_train.py:669): with gradient_accumulation_steps=N the
+        # observable cadence is every N micro-batches. Internal counting
+        # (state.step, checkpoint labels, resume) stays in micro-steps so a
+        # mid-accumulation preemption resumes exactly where it left off.
+        accum = max(1, cfg.optim.gradient_accumulation_steps)
+        # stop at whichever comes first in MICRO-batches: the requested number
+        # of optimizer steps, or the end of the requested epochs (a trailing
+        # partial accumulation at the epoch boundary is simply not applied —
+        # accelerate's dataloader-end behavior)
+        max_micro = min(cfg.max_train_steps * accum,
+                        cfg.num_train_epochs * steps_per_epoch)
+        max_sync = max_micro // accum
         step = start_step
         t_last, imgs_last = time.time(), 0
         last_metrics: dict = {}
         global_bs = cfg.train_batch_size * jax.device_count()
         flops_per_step: float | None = None  # filled after first compiled step
-        log.info("training: %d steps (%d/epoch), global batch %d",
-                 max_steps, steps_per_epoch, global_bs)
-        while step < max_steps:
+        log.info("training: %d optimizer steps (micro-batch accum %d, "
+                 "%d micro/epoch), global batch %d",
+                 max_sync, accum, steps_per_epoch, global_bs)
+        while step < max_micro:
             epoch = step // steps_per_epoch
             for batch in self.loader.epoch(epoch, start_step=step % steps_per_epoch):
                 sharded = pmesh.shard_batch(self.mesh, dict(batch))
                 self.state, metrics = self.step_fn(self.state, sharded, self.train_key)
                 step += 1
                 imgs_last += global_bs
+                at_sync = step % accum == 0
+                sync = step // accum
                 if flops_per_step is None:
                     flops_per_step = self._step_flops(sharded)
-                if step % cfg.log_every == 0 or step == max_steps:
+                if (at_sync and sync % cfg.log_every == 0) or step == max_micro:
                     metrics = jax.device_get(metrics)
                     if not np.isfinite(metrics["loss"]):
                         # fail fast instead of training on garbage (the
@@ -246,18 +266,18 @@ class Trainer:
                         metrics["tflops_per_sec"] = (
                             per_chip * jax.device_count() / 1e12)
                         metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
-                    self.writer.scalars(step, metrics)
+                    self.writer.scalars(sync, metrics)
                     last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                     t_last, imgs_last = time.time(), 0
-                if self.sample_hook and step % cfg.save_steps == 0:
-                    self.sample_hook(self, step)
+                if self.sample_hook and at_sync and sync % cfg.save_steps == 0:
+                    self.sample_hook(self, sync)
                 # preemption check BEFORE the periodic save so the same step is
                 # never written twice inside the shutdown grace window.
                 # Multi-host: the agreement collective must run on EVERY host or
                 # none, so it happens only at the uniform log_every boundary
                 # (a local flag alone must not start a collective).
                 if jax.process_count() > 1:
-                    check_preempt = step % cfg.log_every == 0
+                    check_preempt = at_sync and sync % cfg.log_every == 0
                 else:
                     check_preempt = getattr(self, "_preempted", False)
                 if check_preempt and self._global_preempted():
@@ -268,9 +288,9 @@ class Trainer:
                     self.writer.close()
                     self._uninstall_preemption_handler()
                     return last_metrics
-                if step % cfg.modelsavesteps == 0:
+                if at_sync and sync % cfg.modelsavesteps == 0:
                     self.save()
-                if step >= max_steps:
+                if step >= max_micro:
                     break
         self.save(force=True)
         self.ckpt.wait()

@@ -23,7 +23,8 @@ from dcr_tpu.models.vit import ViTBlock
 def clip_b16_text_config(vocab_size: int = 49408) -> ModelConfig:
     """CLIP ViT-B/16 text tower dims (512 wide, 12 layers, 8 heads)."""
     return ModelConfig(text_vocab_size=vocab_size, text_hidden_size=512,
-                       text_layers=12, text_heads=8, text_max_length=77)
+                       text_layers=12, text_heads=8, text_max_length=77,
+                       text_act="quick_gelu")
 
 
 class CLIPImageTower(nn.Module):
@@ -56,7 +57,8 @@ class CLIPImageTower(nn.Module):
         tokens = tokens + pos.astype(self.dtype)
         tokens = nn.LayerNorm(dtype=self.dtype, name="ln_pre")(tokens)
         for i in range(self.layers):
-            tokens = ViTBlock(self.heads, dtype=self.dtype,
+            # OpenAI CLIP towers use QuickGELU, not exact GELU
+            tokens = ViTBlock(self.heads, dtype=self.dtype, act="quick_gelu",
                               name=f"blocks_{i}")(tokens)
         cls_out = nn.LayerNorm(dtype=self.dtype, name="ln_post")(tokens[:, 0])
         proj = self.param("proj", nn.initializers.normal(0.02),

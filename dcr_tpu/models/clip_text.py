@@ -26,6 +26,8 @@ class CLIPTextOutput(NamedTuple):
 class CLIPLayer(nn.Module):
     heads: int
     dtype: jnp.dtype = jnp.float32
+    # "gelu" (SD-2.x OpenCLIP ViT-H tower) or "quick_gelu" (OpenAI CLIP-B/L)
+    act: str = "gelu"
 
     @nn.compact
     def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
@@ -36,8 +38,10 @@ class CLIPLayer(nn.Module):
         x = x + h
         h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln2")(x)
         h = nn.Dense(4 * d, dtype=self.dtype, name="fc1")(h)
-        # CLIP uses quick-gelu (x * sigmoid(1.702 x))
-        h = h * nn.sigmoid(1.702 * h)
+        if self.act == "quick_gelu":
+            h = h * nn.sigmoid(1.702 * h)
+        else:
+            h = nn.gelu(h, approximate=False)
         h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
         return x + h
 
@@ -62,6 +66,7 @@ class CLIPTextModel(nn.Module):
             if i == cfg.text_layers - 1:
                 penultimate = hidden
             hidden = CLIPLayer(cfg.text_heads, dtype=self.dtype,
+                               act=getattr(cfg, "text_act", "gelu"),
                                name=f"layers_{i}")(hidden, causal)
         ln_final = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="final_layer_norm")
         last = ln_final(hidden)

@@ -223,6 +223,113 @@ def convert_clip_text(sd: StateDict, *, layers: int, heads: int) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# CLIP image tower (+ full CLIP) -> models.clip_image.CLIPImageTower / scorer
+# ---------------------------------------------------------------------------
+
+def convert_clip_image(sd: StateDict, *, layers: int = 12) -> dict:
+    """OpenAI CLIP (`visual.*`, fused in_proj) or transformers CLIPVisionModel
+    (`vision_model.*`, split q/k/v) -> CLIPImageTower params. Reference role:
+    the CLIP retrieval backbone + alignment score (diff_retrieval.py:268-275,
+    utils_ret.py:1045-1066)."""
+    t: dict = {}
+    if any(k.startswith("visual.") for k in sd):
+        _set(t, "patch_embed/kernel", conv_kernel(sd["visual.conv1.weight"]))
+        _set(t, "class_embedding", sd["visual.class_embedding"])
+        _set(t, "pos_embed", sd["visual.positional_embedding"][None])
+        _layernorm(t, "ln_pre", sd, "visual.ln_pre")
+        for i in range(layers):
+            src = f"visual.transformer.resblocks.{i}"
+            dst = f"blocks_{i}"
+            _layernorm(t, f"{dst}/norm1", sd, f"{src}.ln_1")
+            _set(t, f"{dst}/qkv/kernel",
+                 linear_kernel(sd[f"{src}.attn.in_proj_weight"]))
+            _set(t, f"{dst}/qkv/bias", sd[f"{src}.attn.in_proj_bias"])
+            _linear(t, f"{dst}/proj", sd, f"{src}.attn.out_proj")
+            _layernorm(t, f"{dst}/norm2", sd, f"{src}.ln_2")
+            _linear(t, f"{dst}/fc1", sd, f"{src}.mlp.c_fc")
+            _linear(t, f"{dst}/fc2", sd, f"{src}.mlp.c_proj")
+        _layernorm(t, "ln_post", sd, "visual.ln_post")
+        _set(t, "proj", sd["visual.proj"])        # stored [width, embed_dim]
+        return t
+
+    p = "vision_model."
+    if not any(k.startswith(p) for k in sd):
+        raise KeyError("state dict is neither OpenAI CLIP (visual.*) nor "
+                       "transformers CLIPVisionModel (vision_model.*)")
+    _set(t, "patch_embed/kernel",
+         conv_kernel(sd[f"{p}embeddings.patch_embedding.weight"]))
+    _set(t, "class_embedding", sd[f"{p}embeddings.class_embedding"].reshape(-1))
+    _set(t, "pos_embed", sd[f"{p}embeddings.position_embedding.weight"][None])
+    # transformers ships the typo'd name "pre_layrnorm"; accept both spellings
+    pre = f"{p}pre_layrnorm" if f"{p}pre_layrnorm.weight" in sd else f"{p}pre_layernorm"
+    _layernorm(t, "ln_pre", sd, pre)
+    for i in range(layers):
+        src = f"{p}encoder.layers.{i}"
+        dst = f"blocks_{i}"
+        _layernorm(t, f"{dst}/norm1", sd, f"{src}.layer_norm1")
+        qkv_w = np.concatenate([sd[f"{src}.self_attn.{n}_proj.weight"]
+                                for n in ("q", "k", "v")], axis=0)
+        qkv_b = np.concatenate([sd[f"{src}.self_attn.{n}_proj.bias"]
+                                for n in ("q", "k", "v")], axis=0)
+        _set(t, f"{dst}/qkv/kernel", linear_kernel(qkv_w))
+        _set(t, f"{dst}/qkv/bias", qkv_b)
+        _linear(t, f"{dst}/proj", sd, f"{src}.self_attn.out_proj")
+        _layernorm(t, f"{dst}/norm2", sd, f"{src}.layer_norm2")
+        _linear(t, f"{dst}/fc1", sd, f"{src}.mlp.fc1")
+        _linear(t, f"{dst}/fc2", sd, f"{src}.mlp.fc2")
+    _layernorm(t, "ln_post", sd, f"{p}post_layernorm")
+    if "visual_projection.weight" in sd:
+        _set(t, "proj", linear_kernel(sd["visual_projection.weight"]))
+    return t
+
+
+def convert_openai_clip_text(sd: StateDict, *, layers: int = 12,
+                             heads: int = 8) -> dict:
+    """OpenAI CLIP text tower (`transformer.resblocks.*`, fused in_proj) ->
+    models.clip_text.CLIPTextModel params."""
+    t: dict = {}
+    emb = sd["token_embedding.weight"]
+    d = emb.shape[1]
+    head_dim = d // heads
+    _set(t, "token_embedding/embedding", emb)
+    _set(t, "position_embedding", sd["positional_embedding"])
+    for i in range(layers):
+        src = f"transformer.resblocks.{i}"
+        dst = f"layers_{i}"
+        _layernorm(t, f"{dst}/ln1", sd, f"{src}.ln_1")
+        _layernorm(t, f"{dst}/ln2", sd, f"{src}.ln_2")
+        w = sd[f"{src}.attn.in_proj_weight"]      # [3D, D] rows q;k;v
+        b = sd[f"{src}.attn.in_proj_bias"]
+        for j, flax_name in enumerate(("query", "key", "value")):
+            _set(t, f"{dst}/attn/{flax_name}/kernel",
+                 linear_kernel(w[j * d:(j + 1) * d]).reshape(d, heads, head_dim))
+            _set(t, f"{dst}/attn/{flax_name}/bias",
+                 b[j * d:(j + 1) * d].reshape(heads, head_dim))
+        _set(t, f"{dst}/attn/out/kernel",
+             linear_kernel(sd[f"{src}.attn.out_proj.weight"]).reshape(
+                 heads, head_dim, d))
+        _set(t, f"{dst}/attn/out/bias", sd[f"{src}.attn.out_proj.bias"])
+        _linear(t, f"{dst}/fc1", sd, f"{src}.mlp.c_fc")
+        _linear(t, f"{dst}/fc2", sd, f"{src}.mlp.c_proj")
+    _layernorm(t, "final_layer_norm", sd, "ln_final")
+    return t
+
+
+def convert_openai_clip(sd: StateDict, *, image_layers: int = 12,
+                        text_layers: int = 12, text_heads: int = 8) -> dict:
+    """Full OpenAI CLIP archive -> CLIPScorer params
+    ({image, text, text_projection}). The image tower's fused qkv copies
+    head-agnostically (our ViTBlock splits at apply time); only the text
+    tower's flax attention needs the head count."""
+    return {
+        "image": convert_clip_image(sd, layers=image_layers),
+        "text": convert_openai_clip_text(sd, layers=text_layers,
+                                         heads=text_heads),
+        "text_projection": np.asarray(sd["text_projection"]),  # [D, embed]
+    }
+
+
+# ---------------------------------------------------------------------------
 # diffusers UNet2DConditionModel -> models.unet2d.UNet2DCondition
 # ---------------------------------------------------------------------------
 
@@ -307,8 +414,27 @@ def _vae_attn(t: dict, dst: str, sd: StateDict, src: str) -> None:
     _linear(t, f"{dst}/to_out", sd, f"{src}.to_out.0")
 
 
+_VAE_ATTN_RENAMES = {  # diffusers <=0.16 AttentionBlock -> >=0.17 Attention
+    "query": "to_q", "key": "to_k", "value": "to_v", "proj_attn": "to_out.0"}
+
+
+def normalize_vae_attn_names(sd: StateDict) -> dict[str, Arr]:
+    """On-hub SD VAE checkpoints (serialized by diffusers <=0.16, the era the
+    reference pins — env.yaml:325 diffusers==0.14.0) name the mid-block
+    attention query/key/value/proj_attn; later diffusers renamed these
+    to_q/to_k/to_v/to_out.0. Map the old names so both load."""
+    out = {}
+    for k, v in sd.items():
+        m = re.match(r"(.*\.attentions\.\d+)\.(query|key|value|proj_attn)\.(.+)", k)
+        if m:
+            k = f"{m.group(1)}.{_VAE_ATTN_RENAMES[m.group(2)]}.{m.group(3)}"
+        out[k] = v
+    return out
+
+
 def convert_vae(sd: StateDict, *, block_out_channels=(128, 256, 512, 512),
                 layers_per_block: int = 2) -> dict:
+    sd = normalize_vae_attn_names(sd)
     t: dict = {}
     n = len(block_out_channels)
     enc, dec = "encoder", "decoder"

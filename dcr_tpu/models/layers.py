@@ -96,16 +96,36 @@ class ResnetBlock2D(nn.Module):
 
 
 class CrossAttention(nn.Module):
-    """Multi-head attention; self-attention when context is None."""
+    """Multi-head attention; self-attention when context is None.
+
+    When a mesh with a seq axis >1 is attached and the (self-attention)
+    sequence reaches seq_parallel_min_seq, dispatches to exact ring attention
+    over the mesh's `seq` axis (ops/ring_attention.py) — the long-context
+    path (SURVEY §5.7; reference's only analogue is single-GPU xformers,
+    diff_train.py:578)."""
 
     num_heads: int
     head_dim: int
     out_dim: int
     use_flash: bool = True
     dtype: jnp.dtype = jnp.float32
+    mesh: Optional[jax.sharding.Mesh] = None
+    seq_parallel_min_seq: int = 4096
+
+    def _ring_ok(self, b: int, sq: int, is_self: bool) -> bool:
+        if not is_self or self.mesh is None:
+            return False
+        from dcr_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQ_AXIS
+
+        shape = dict(self.mesh.shape)
+        n_seq = shape.get(SEQ_AXIS, 1)
+        n_batch = shape.get(DATA_AXIS, 1) * shape.get(FSDP_AXIS, 1)
+        return (n_seq > 1 and sq >= self.seq_parallel_min_seq
+                and sq % n_seq == 0 and b % n_batch == 0)
 
     @nn.compact
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        is_self = context is None
         context = x if context is None else context
         inner = self.num_heads * self.head_dim
         q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
@@ -116,7 +136,12 @@ class CrossAttention(nn.Module):
         q = q.reshape(b, sq, self.num_heads, self.head_dim)
         k = k.reshape(b, sk, self.num_heads, self.head_dim)
         v = v.reshape(b, sk, self.num_heads, self.head_dim)
-        out = dot_product_attention(q, k, v, use_flash=self.use_flash)
+        if self._ring_ok(b, sq, is_self):
+            from dcr_tpu.ops.ring_attention import ring_self_attention
+
+            out = ring_self_attention(q, k, v, self.mesh)
+        else:
+            out = dot_product_attention(q, k, v, use_flash=self.use_flash)
         out = out.reshape(b, sq, inner)
         return nn.Dense(self.out_dim, dtype=self.dtype, name="to_out")(out)
 
@@ -138,18 +163,25 @@ class FeedForward(nn.Module):
 
 
 class BasicTransformerBlock(nn.Module):
-    """self-attn → cross-attn → ff, each pre-LayerNormed with residuals."""
+    """self-attn → cross-attn → ff, each pre-LayerNormed with residuals.
+    Only the self-attention (attn1) is eligible for sequence parallelism —
+    cross-attention's K/V is the 77-token text context."""
 
     dim: int
     num_heads: int
     head_dim: int
     use_flash: bool = True
     dtype: jnp.dtype = jnp.float32
+    mesh: Optional[jax.sharding.Mesh] = None
+    seq_parallel_min_seq: int = 4096
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
         attn = CrossAttention(self.num_heads, self.head_dim, self.dim,
-                              use_flash=self.use_flash, dtype=self.dtype, name="attn1")
+                              use_flash=self.use_flash, dtype=self.dtype,
+                              mesh=self.mesh,
+                              seq_parallel_min_seq=self.seq_parallel_min_seq,
+                              name="attn1")
         x = x + attn(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x))
         xattn = CrossAttention(self.num_heads, self.head_dim, self.dim,
                                use_flash=self.use_flash, dtype=self.dtype, name="attn2")
@@ -168,6 +200,8 @@ class Transformer2D(nn.Module):
     num_groups: int = 32
     use_flash: bool = True
     dtype: jnp.dtype = jnp.float32
+    mesh: Optional[jax.sharding.Mesh] = None
+    seq_parallel_min_seq: int = 4096
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -180,6 +214,8 @@ class Transformer2D(nn.Module):
         for i in range(self.num_layers):
             out = BasicTransformerBlock(inner, self.num_heads, self.head_dim,
                                         use_flash=self.use_flash, dtype=self.dtype,
+                                        mesh=self.mesh,
+                                        seq_parallel_min_seq=self.seq_parallel_min_seq,
                                         name=f"blocks_{i}")(out, context)
         out = nn.Dense(c, dtype=self.dtype, name="proj_out")(out)
         return out.reshape(b, h, w, c) + residual

@@ -25,6 +25,9 @@ from dcr_tpu.models import layers as L
 class UNet2DCondition(nn.Module):
     config: ModelConfig
     dtype: jnp.dtype = jnp.float32
+    # attach a mesh with a seq axis >1 to enable ring-attention sequence
+    # parallelism in the spatial self-attentions (config.seq_parallel_min_seq)
+    mesh: Optional[jax.sharding.Mesh] = None
 
     @nn.compact
     def __call__(self, sample: jax.Array, timesteps: jax.Array,
@@ -60,6 +63,8 @@ class UNet2DCondition(nn.Module):
                                         num_layers=cfg.transformer_layers,
                                         num_groups=groups,
                                         use_flash=cfg.flash_attention, dtype=dtype,
+                                        mesh=self.mesh,
+                                        seq_parallel_min_seq=cfg.seq_parallel_min_seq,
                                         name=f"down_{i}_attn_{j}")(h, context)
                 skips.append(h)
             if not is_final:
@@ -73,6 +78,8 @@ class UNet2DCondition(nn.Module):
         h = L.Transformer2D(mid_ch // head_dim, head_dim,
                             num_layers=cfg.transformer_layers, num_groups=groups,
                             use_flash=cfg.flash_attention, dtype=dtype,
+                            mesh=self.mesh,
+                            seq_parallel_min_seq=cfg.seq_parallel_min_seq,
                             name="mid_attn")(h, context)
         h = L.ResnetBlock2D(mid_ch, num_groups=groups, dtype=dtype,
                             name="mid_res_1")(h, temb, deterministic)
@@ -91,6 +98,8 @@ class UNet2DCondition(nn.Module):
                                         num_layers=cfg.transformer_layers,
                                         num_groups=groups,
                                         use_flash=cfg.flash_attention, dtype=dtype,
+                                        mesh=self.mesh,
+                                        seq_parallel_min_seq=cfg.seq_parallel_min_seq,
                                         name=f"up_{block_idx}_attn_{j}")(h, context)
             if block_idx > 0:
                 h = L.Upsample2D(ch, dtype=dtype, name=f"up_{block_idx}_upsample")(h)
@@ -103,9 +112,12 @@ class UNet2DCondition(nn.Module):
         return h.astype(jnp.float32)
 
 
-def init_unet(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32):
-    """Initialize params with tiny dummy shapes (shape-polymorphic in H/W)."""
-    model = UNet2DCondition(cfg, dtype=dtype)
+def init_unet(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32, mesh=None):
+    """Initialize params with tiny dummy shapes (shape-polymorphic in H/W).
+    `mesh` (seq axis >1) turns on ring-attention sequence parallelism; init
+    itself always runs the single-chip path (batch-1 dummy shapes never pass
+    the divisibility gate)."""
+    model = UNet2DCondition(cfg, dtype=dtype, mesh=mesh)
     sample = jnp.zeros((1, cfg.sample_size, cfg.sample_size, cfg.in_channels))
     t = jnp.zeros((1,), jnp.int32)
     ctx = jnp.zeros((1, cfg.text_max_length, cfg.cross_attention_dim))

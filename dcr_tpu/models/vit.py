@@ -37,6 +37,9 @@ class ViTBlock(nn.Module):
     num_heads: int
     mlp_ratio: float = 4.0
     dtype: jnp.dtype = jnp.float32
+    # "gelu" (DINO) or "quick_gelu" (OpenAI CLIP: x·σ(1.702x)); real CLIP
+    # weights silently drift without the matching activation.
+    act: str = "gelu"
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -53,7 +56,10 @@ class ViTBlock(nn.Module):
         x = x + out
         h = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
         h = nn.Dense(int(d * self.mlp_ratio), dtype=self.dtype, name="fc1")(h)
-        h = nn.gelu(h)
+        if self.act == "quick_gelu":
+            h = h * jax.nn.sigmoid(1.702 * h)
+        else:
+            h = nn.gelu(h)
         h = nn.Dense(d, dtype=self.dtype, name="fc2")(h)
         return x + h
 
@@ -123,9 +129,23 @@ def vit_base(patch_size: int = 16, **kw) -> VisionTransformer:
     return VisionTransformer(patch_size, 768, 12, 12, **kw)
 
 
+def _dino_resnet50():
+    # plain torchvision-resnet50 trunk + avgpool, the reference's
+    # dino_resnet50 hub entry (dino_vits.py:438-452); pretrained weights load
+    # via convert.convert_resnet50
+    from dcr_tpu.models.resnet import ResNet50Classifier
+
+    return ResNet50Classifier()
+
+
 DINO_ARCHS = {
     "dino_vits16": lambda: vit_small(16),
     "dino_vits8": lambda: vit_small(8),
     "dino_vitb16": lambda: vit_base(16),
     "dino_vitb8": lambda: vit_base(8),
+    # CIFAR-10-finetuned ViT-B/16 (reference dino_vits.py:340-360): same
+    # architecture, different checkpoint; pos-embed interpolation handles the
+    # 32px grid
+    "dino_vitb_cifar10": lambda: vit_base(16),
+    "dino_resnet50": _dino_resnet50,
 }

@@ -36,7 +36,7 @@ def dot_product_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     if use_flash and _on_tpu() and mask is None:
         from dcr_tpu.ops import flash_attention as fa
 
-        if fa.supported(q, k, v):
+        if fa.should_use(q, k, v):
             return fa.flash_attention(q, k, v)
     return _xla_attention(q, k, v, mask)
 

@@ -6,10 +6,19 @@ self-attention at 512px+ (S=4096 latent tokens). Classic FlashAttention
 (Dao et al. 2022):
 
 - forward: online softmax over key blocks, f32 logits/statistics/accumulator on
-  the MXU while operands stay bf16; also emits the per-row logsumexp.
-- backward: recompute-based fused kernels — dQ with a (q-block × key-loop)
-  grid, dK/dV with a (k-block × query-loop) grid — never materializing the
-  S×S matrix.
+  the MXU while operands stay bf16; emits the per-row logsumexp lane-broadcast
+  to [BH, S, 128] (TPU tiling requires >=128 lanes on the last dim — same
+  trick as jax.experimental.pallas.ops.tpu.flash_attention's MIN_BLOCK_SIZE).
+- backward: recompute-based fused kernels that never materialize the S×S
+  matrix. dQ: grid over q blocks, key fori-loop inside. dK/dV: 3-D grid
+  (bh, k block, q block) accumulating into f32 VMEM scratch across the
+  sequential q dimension — full-sequence tensors never sit in VMEM, so the
+  kernel scales to S=16k+ within the ~16 MB/core budget. delta (= rowsum
+  do∘o) is recomputed per block in-kernel instead of being passed as a
+  full-sequence operand.
+
+Block sizes are tunable per call; defaults come from a measured-on-v5e policy
+(_resolve_blocks; sweep in tools/sweep_flash.py, table in BASELINE.md).
 
 Layout contract: [B, S, H, D] at the dispatcher, reshaped to [B*H, S, D] here.
 interpret=True runs the same kernels through the Pallas interpreter (CPU tests).
@@ -31,28 +40,69 @@ except Exception:  # pragma: no cover
     pltpu = None
     _VMEM = None
 
+# legacy defaults (round-1); _resolve_blocks picks per-shape tuned values
 BLOCK_Q = 256
 BLOCK_K = 128
 NEG_INF = -1e30
+LANES = 128      # TPU lane count: min last-dim tile for f32 outputs
+
+# Dispatch threshold: below this key length XLA's fused attention wins on a
+# v5e (the S×S weight tensor still fits HBM comfortably and XLA's single
+# fused kernel beats the Pallas pipeline's overheads); at/above it the flash
+# kernel wins on memory and is competitive on time. Measured 2026-07-29 —
+# BASELINE.md "Pallas kernel table".
+FLASH_MIN_SEQ = 2048
+
+
+def _resolve_blocks(sq: int, sk: int, block_q: int | None,
+                    block_k: int | None) -> tuple[int, int]:
+    """Pick (block_q, block_k): explicit args win, else the tuned default
+    clamped so blocks divide the sequence lengths. (1024, 1024) won the v5e
+    sweep at every large shape (tools/sweep_flash.py; BASELINE.md table)."""
+    bq = block_q or min(1024, sq)
+    bk = block_k or min(1024, sk)
+    while sq % bq:
+        bq //= 2
+    while sk % bk:
+        bk //= 2
+    return max(bq, 8), max(bk, 8)
 
 
 def supported(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
-    """Kernel-friendly shapes: blocks divide sequence lengths, D fits the MXU lane
-    layout. Anything else falls back to XLA attention (correct, still fused)."""
+    """Kernel-capable shapes: 128 divides both sequence lengths, D fits the MXU
+    lane layout. Anything else falls back to XLA attention (correct, still
+    fused). Capability only — the dispatch *policy* is should_use()."""
     if q.ndim != 4:
         return False
     _, sq, _, d = q.shape
     sk = k.shape[1]
     return (
-        sq % BLOCK_Q == 0
-        and sk % BLOCK_K == 0
+        sq % 128 == 0
+        and sk % 128 == 0
         and d in (64, 128, 256)
         and q.dtype in (jnp.float32, jnp.bfloat16)
     )
 
 
+def should_use(q: jax.Array, k: jax.Array, v: jax.Array) -> bool:
+    """Dispatch policy: the Pallas kernel handles this attention only where it
+    actually beats XLA's fused attention on the measured ladder (sk >=
+    FLASH_MIN_SEQ) — below that XLA wins on time and the S×S weight tensor is
+    small enough that flash's memory advantage is moot."""
+    return supported(q, k, v) and k.shape[1] >= FLASH_MIN_SEQ
+
+
 def _mem(interpret: bool) -> dict:
     return {} if (interpret or _VMEM is None) else {"memory_space": _VMEM}
+
+
+def _compiler_params(interpret: bool, semantics: tuple[str, ...]):
+    """Tell Mosaic which grid dims are embarrassingly parallel; sequential
+    (accumulating) dims must be 'arbitrary'."""
+    if interpret or pltpu is None:
+        return {}
+    return {"compiler_params": pltpu.CompilerParams(
+        dimension_semantics=semantics)}
 
 
 # ---------------------------------------------------------------------------
@@ -88,48 +138,53 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale: float,
     acc0 = jnp.zeros((bq, d), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, sk // block_k, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # lane-broadcast so the f32 output block meets the (8, 128) tile minimum
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, LANES))
 
 
 def _flash_fwd(q3: jax.Array, k3: jax.Array, v3: jax.Array, *,
-               interpret: bool) -> tuple[jax.Array, jax.Array]:
-    """q3/k3/v3: [BH, S, D] -> (out [BH,S,D], lse [BH,S])."""
+               interpret: bool, block_q: int | None = None,
+               block_k: int | None = None) -> tuple[jax.Array, jax.Array]:
+    """q3/k3/v3: [BH, S, D] -> (out [BH,S,D], lse [BH,S,LANES] lane-broadcast)."""
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
-    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=BLOCK_K)
+    kernel = functools.partial(_fwd_kernel, scale=scale, block_k=bk)
     mem = _mem(interpret)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, sq // BLOCK_Q),
+        grid=(bh, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0), **mem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
         interpret=interpret,
+        **_compiler_params(interpret, ("parallel", "parallel")),
     )(q3, k3, v3)
     return out, lse
 
 
 # ---------------------------------------------------------------------------
-# backward (recompute; FlashAttention eq. dS = P ∘ (dP − D))
+# backward (recompute; FlashAttention eq. dS = P ∘ (dP − D), D = rowsum do∘o)
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref, *,
                    scale: float, block_k: int):
     q = q_ref[0]                                       # [bq, D]
     do = do_ref[0]
-    lse = lse_ref[0][:, None]                          # [bq, 1]
-    delta = delta_ref[0][:, None]
+    lse = lse_ref[0, :, 0:1]                           # [bq, 1]
+    delta = jnp.sum(do_ref[0].astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bq, 1]
     sk = k_ref.shape[1]
     bq, d = q.shape
     in_dtype = q.dtype
@@ -151,85 +206,100 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale: float, block_q: int):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    q_steps: int):
+    """Grid (bh, k block, q block); the q dim is sequential — dK/dV accumulate
+    in f32 scratch across it and flush to the outputs on the last q step."""
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
     k_blk = k_ref[0]                                   # [bk, D]
     v_blk = v_ref[0]
-    sq = q_ref.shape[1]
-    bk, d = k_blk.shape
     in_dtype = k_blk.dtype
+    q = q_ref[0]                                       # [bq, D]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0:1]                           # [bq, 1]
+    delta = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bq, 1]
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block_q, block_q), :]
-        do = do_ref[0, pl.ds(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block_q, block_q)][:, None]
-        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        p = jnp.exp(s - lse)                           # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
-            p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # p^T @ do -> [bk, D]
-        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
-        dk_new = dk + jax.lax.dot_general(
-            ds.astype(in_dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # ds^T @ q -> [bk, D]
-        return dk_new, dv_new
+    s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    p = jnp.exp(s - lse)                               # [bq, bk]
+    dv_acc[...] += jax.lax.dot_general(
+        p.astype(in_dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # p^T @ do -> [bk, D]
+    dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta)
+    dk_acc[...] += jax.lax.dot_general(
+        ds.astype(in_dtype), q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)            # ds^T @ q -> [bk, D]
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, sq // block_q, body, (dk0, dv0))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == q_steps - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, *, interpret: bool):
+def _flash_bwd(q3, k3, v3, o3, lse, do3, *, interpret: bool,
+               block_q: int | None = None, block_k: int | None = None):
     bh, sq, d = q3.shape
     sk = k3.shape[1]
+    bq, bk = _resolve_blocks(sq, sk, block_q, block_k)
     scale = 1.0 / (d ** 0.5)
     mem = _mem(interpret)
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, scale=scale, block_k=BLOCK_K),
-        grid=(bh, sq // BLOCK_Q),
+        functools.partial(_bwd_dq_kernel, scale=scale, block_k=bk),
+        grid=(bh, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
             pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0), **mem),
-            pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
-            pl.BlockSpec((1, BLOCK_Q), lambda b, i: (b, i), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0), **mem),
         ],
-        out_specs=pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0), **mem),
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0), **mem),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q3.dtype),
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+        **_compiler_params(interpret, ("parallel", "parallel")),
+    )(q3, k3, v3, o3, do3, lse)
 
+    if pltpu is None:  # pragma: no cover - pallas-tpu metadata always imports
+        raise NotImplementedError(
+            "flash-attention backward needs jax.experimental.pallas.tpu for "
+            "its VMEM scratch accumulators; use the XLA attention fallback")
+    scratch = [pltpu.VMEM((bk, d), jnp.float32),
+               pltpu.VMEM((bk, d), jnp.float32)]
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=BLOCK_Q),
-        grid=(bh, sk // BLOCK_K),
+        functools.partial(_bwd_dkv_kernel, scale=scale, q_steps=sq // bq),
+        grid=(bh, sk // bk, sq // bq),
         in_specs=[
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0), **mem),
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0), **mem),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0), **mem),
-            pl.BlockSpec((1, sq), lambda b, j: (b, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0), **mem),
+            pl.BlockSpec((1, bq, LANES), lambda b, j, i: (b, i, 0), **mem),
         ],
         out_specs=[
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
-            pl.BlockSpec((1, BLOCK_K, d), lambda b, j: (b, j, 0), **mem),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
+            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0), **mem),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k3.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v3.dtype),
         ],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(q3, k3, v3, do3, lse, delta)
+        **_compiler_params(interpret, ("parallel", "parallel", "arbitrary")),
+    )(q3, k3, v3, o3, do3, lse)
     return dq, dk, dv
 
 
@@ -247,24 +317,32 @@ def _from3(x3: jax.Array, b: int, h: int) -> jax.Array:
     return x3.reshape(b, h, s, d).transpose(0, 2, 1, 3)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array,
-                    interpret: bool = False) -> jax.Array:
+                    interpret: bool = False, block_q: int | None = None,
+                    block_k: int | None = None) -> jax.Array:
     """Flash attention over [B, S, H, D] tensors."""
-    out, _ = _flash_fwd(_to3(q), _to3(k), _to3(v), interpret=interpret)
+    out, _ = _flash_fwd(_to3(q), _to3(k), _to3(v), interpret=interpret,
+                        block_q=block_q, block_k=block_k)
     return _from3(out, q.shape[0], q.shape[2])
 
 
-def _fwd_rule(q, k, v, interpret):
+def _fwd_rule(q, k, v, interpret, block_q, block_k):
     q3, k3, v3 = _to3(q), _to3(k), _to3(v)
-    o3, lse = _flash_fwd(q3, k3, v3, interpret=interpret)
+    o3, lse = _flash_fwd(q3, k3, v3, interpret=interpret,
+                         block_q=block_q, block_k=block_k)
     b, h = q.shape[0], q.shape[2]
-    return _from3(o3, b, h), (q3, k3, v3, o3, lse, b, h)
+    # store the residual compact [BH, S] — the lane-broadcast [BH, S, 128]
+    # would pin 128x the memory from forward to backward
+    return _from3(o3, b, h), (q3, k3, v3, o3, lse[:, :, 0], b, h)
 
 
-def _bwd_rule(interpret, residuals, g):
-    q3, k3, v3, o3, lse, b, h = residuals
-    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, _to3(g), interpret=interpret)
+def _bwd_rule(interpret, block_q, block_k, residuals, g):
+    q3, k3, v3, o3, lse2, b, h = residuals
+    lse = jnp.broadcast_to(lse2[:, :, None], (*lse2.shape, LANES))
+    dq3, dk3, dv3 = _flash_bwd(q3, k3, v3, o3, lse, _to3(g),
+                               interpret=interpret,
+                               block_q=block_q, block_k=block_k)
     return _from3(dq3, b, h), _from3(dk3, b, h), _from3(dv3, b, h)
 
 

@@ -44,7 +44,15 @@ def load_checkpoint_models(ckpt_dir: str | Path):
     """(models, params) from an HF-layout dir written by Trainer.export_checkpoint.
     Model shapes come from model_index.json (our serialized ModelConfig)."""
     ckpt_dir = Path(ckpt_dir)
-    model_cfg = from_dict(ModelConfig, json.loads((ckpt_dir / "model_index.json").read_text()))
+    index = json.loads((ckpt_dir / "model_index.json").read_text())
+    # round-2 exports are diffusers-style model_index.json with our native
+    # ModelConfig nested under "model_config"; round-1 exports were the flat
+    # dict, and their CLIPTextModel hardcoded quick_gelu — preserve those
+    # numerics when the key predates the text_act config field.
+    cfg_dict = index.get("model_config", index)
+    if "model_config" not in index:
+        cfg_dict = {**cfg_dict, "text_act": cfg_dict.get("text_act", "quick_gelu")}
+    model_cfg = from_dict(ModelConfig, cfg_dict)
     sched_cfg = json.loads((ckpt_dir / "scheduler" / "scheduler_config.json").read_text())
     params = {
         "unet": import_hf_layout(ckpt_dir, "unet"),

@@ -15,6 +15,21 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# Persistent compile cache: the suite's dominant cost on a small box is XLA
+# recompiles of identical programs (every Trainer/make_train_step call is a new
+# closure -> new jit object). Cache survives across tests AND across runs.
+from pathlib import Path  # noqa: E402
+
+_cache = Path(__file__).parent / ".jax_cache_cpu"
+jax.config.update("jax_compilation_cache_dir", str(_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+try:  # CPU-backend caching is gated behind an allowlist in some jax versions
+    jax.config.update("jax_persistent_cache_enable_xla_caches",
+                      "xla_gpu_per_fusion_autotune_cache_dir")
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -139,15 +139,17 @@ def test_dino_vit_structural_roundtrip():
     assert not CV.check_converted(params, converted)
 
 
-def test_clip_text_golden_parity_with_transformers():
-    """The one converter we can verify against the real torch implementation."""
+@pytest.mark.parametrize("act", ["gelu", "quick_gelu"])
+def test_clip_text_golden_parity_with_transformers(act):
+    """Verified against the real torch implementation, at both activations:
+    "gelu" (SD-2.x OpenCLIP ViT-H tower) and "quick_gelu" (OpenAI CLIP-B/L)."""
     torch = pytest.importorskip("torch")
     from transformers import CLIPTextConfig, CLIPTextModel as HFCLIPText
 
     hf_cfg = CLIPTextConfig(
         vocab_size=99, hidden_size=32, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=2,
-        max_position_embeddings=16, hidden_act="quick_gelu")
+        max_position_embeddings=16, hidden_act=act)
     torch.manual_seed(0)
     hf_model = HFCLIPText(hf_cfg).eval()
     sd = CV.torch_state_dict_to_numpy(hf_model)
@@ -156,7 +158,7 @@ def test_clip_text_golden_parity_with_transformers():
     from dcr_tpu.models.clip_text import CLIPTextModel
 
     cfg = ModelConfig(text_vocab_size=99, text_hidden_size=32, text_layers=2,
-                      text_heads=2, text_max_length=16)
+                      text_heads=2, text_max_length=16, text_act=act)
     ours = CLIPTextModel(cfg)
     init_params = ours.init(jax.random.key(0),
                             jnp.zeros((1, 16), jnp.int32))["params"]

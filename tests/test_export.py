@@ -1,0 +1,295 @@
+"""Manifest-driven converter/exporter validation (VERDICT round-1 items 3-5).
+
+Round 1's UNet/VAE converter tests synthesized torch state dicts from the
+converters' own inverse name maps — circular. Here the source of truth is the
+vendored SD-2.1 manifests (tests/fixtures/sd21_*_keys.json): key names +
+shapes of the real diffusers 0.14 / transformers state dicts (the text one is
+dumped from a live transformers CLIPTextModel; generator:
+tools/gen_sd21_manifest.py). Converters must consume exactly the manifest key
+set; exporters must produce it byte-for-byte.
+"""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from dcr_tpu.core.config import ModelConfig
+from dcr_tpu.models import convert as CV
+from dcr_tpu.models import export as EX
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _load(name: str) -> dict[str, list[int]]:
+    return json.loads((FIXTURES / name).read_text())
+
+
+def _zeros_sd(manifest: dict[str, list[int]]) -> dict[str, np.ndarray]:
+    return {k: np.zeros(s, np.float16) for k, s in manifest.items()}
+
+
+def _shape_tree(init_fn, *args):
+    """Param tree of ShapeDtypeStructs without materializing 865M params."""
+    return jax.eval_shape(init_fn, *args)
+
+
+@pytest.fixture(scope="module")
+def sd21_cfg() -> ModelConfig:
+    return ModelConfig()          # full SD-2.1 dims
+
+
+def test_unet_converter_consumes_real_sd21_manifest(sd21_cfg):
+    from dcr_tpu.models.unet2d import init_unet
+
+    manifest = _load("sd21_unet_keys.json")
+    converted = CV.convert_unet(_zeros_sd(manifest))
+    expected = _shape_tree(lambda k: init_unet(sd21_cfg, k)[1], jax.random.key(0))
+    problems = CV.check_converted(expected, converted)
+    assert not problems, problems[:10]
+
+
+def test_vae_converter_consumes_real_sd21_manifest(sd21_cfg):
+    """The manifest uses the 0.14-era AttentionBlock naming
+    (query/key/value/proj_attn) that on-hub SD VAE checkpoints carry; the
+    converter must normalize it."""
+    from dcr_tpu.models.vae import init_vae
+
+    manifest = _load("sd21_vae_keys.json")
+    converted = CV.convert_vae(_zeros_sd(manifest))
+    expected = _shape_tree(lambda k: init_vae(sd21_cfg, k)[1], jax.random.key(0))
+    problems = CV.check_converted(expected, converted)
+    assert not problems, problems[:10]
+
+
+def test_text_converter_consumes_real_sd21_manifest(sd21_cfg):
+    from dcr_tpu.models.clip_text import init_clip_text
+
+    manifest = _load("sd21_text_keys.json")
+    converted = CV.convert_clip_text(_zeros_sd(manifest),
+                                     layers=sd21_cfg.text_layers,
+                                     heads=sd21_cfg.text_heads)
+    expected = _shape_tree(lambda k: init_clip_text(sd21_cfg, k)[1],
+                           jax.random.key(0))
+    problems = CV.check_converted(expected, converted)
+    assert not problems, problems[:10]
+
+
+# ---------------------------------------------------------------------------
+# export: key set must equal the manifest byte-for-byte
+# ---------------------------------------------------------------------------
+
+def _assert_sd_matches_manifest(sd: dict, manifest: dict) -> None:
+    missing = sorted(set(manifest) - set(sd))
+    extra = sorted(set(sd) - set(manifest))
+    assert not missing and not extra, {"missing": missing[:10], "extra": extra[:10]}
+    bad = [k for k in manifest if list(sd[k].shape) != manifest[k]]
+    assert not bad, [(k, sd[k].shape, manifest[k]) for k in bad[:10]]
+
+
+def test_unet_export_keys_byte_for_byte(sd21_cfg):
+    manifest = _load("sd21_unet_keys.json")
+    converted = CV.convert_unet(_zeros_sd(manifest))
+    _assert_sd_matches_manifest(EX.unet_to_diffusers(converted), manifest)
+
+
+def test_vae_export_keys_byte_for_byte(sd21_cfg):
+    manifest = _load("sd21_vae_keys.json")
+    converted = CV.convert_vae(_zeros_sd(manifest))
+    _assert_sd_matches_manifest(EX.vae_to_diffusers(converted), manifest)
+
+
+def test_text_export_keys_byte_for_byte(sd21_cfg):
+    manifest = _load("sd21_text_keys.json")
+    converted = CV.convert_clip_text(_zeros_sd(manifest),
+                                     layers=sd21_cfg.text_layers,
+                                     heads=sd21_cfg.text_heads)
+    _assert_sd_matches_manifest(EX.text_to_transformers(converted), manifest)
+
+
+def test_text_export_loads_into_real_transformers():
+    """Round-trip through a LIVE transformers CLIPTextModel: our export must
+    load_state_dict with strict=True and reproduce our activations."""
+    torch = pytest.importorskip("torch")
+    from transformers import CLIPTextConfig, CLIPTextModel as HFCLIPText
+
+    from dcr_tpu.models.clip_text import init_clip_text
+
+    cfg = ModelConfig(text_vocab_size=99, text_hidden_size=32, text_layers=2,
+                      text_heads=2, text_max_length=16, text_act="gelu")
+    ours, params = init_clip_text(cfg, jax.random.key(3))
+    sd = EX.text_to_transformers(params)
+
+    hf_cfg = CLIPTextConfig(
+        vocab_size=99, hidden_size=32, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=2,
+        max_position_embeddings=16, hidden_act="gelu")
+    hf = HFCLIPText(hf_cfg).eval()
+    missing, unexpected = hf.load_state_dict(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()},
+        strict=False)
+    assert not unexpected, unexpected
+    assert all("position_ids" in m for m in missing), missing
+
+    ids = np.array([[5, 7, 9, 11, 2, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0]], np.int64)
+    with torch.no_grad():
+        hf_out = hf(input_ids=torch.from_numpy(ids)).last_hidden_state.numpy()
+    import jax.numpy as jnp
+
+    our_out = ours.apply({"params": params},
+                         jnp.asarray(ids, jnp.int32)).last_hidden_state
+    np.testing.assert_allclose(np.asarray(our_out), hf_out, atol=2e-5, rtol=1e-4)
+
+
+def test_hf_layout_export_tiny_end_to_end(tmp_path):
+    """Integration: export_hf_layout writes npz + safetensors + configs, and
+    the safetensors round-trip back through the forward converters."""
+    from safetensors.numpy import load_file
+
+    from dcr_tpu.core.checkpoint import export_hf_layout, import_hf_layout
+    from dcr_tpu.core.config import to_dict
+    from dcr_tpu.models.unet2d import init_unet
+    from dcr_tpu.models.vae import init_vae
+
+    cfg = ModelConfig.tiny()
+    _, up = init_unet(cfg, jax.random.key(0))
+    _, vp = init_vae(cfg, jax.random.key(1))
+    export_hf_layout(tmp_path / "ckpt", unet=up, vae=vp,
+                     scheduler_config={"num_train_timesteps": 1000},
+                     model_config=to_dict(cfg))
+
+    assert (tmp_path / "ckpt" / "unet" / "config.json").exists()
+    sched = json.loads((tmp_path / "ckpt" / "scheduler" /
+                        "scheduler_config.json").read_text())
+    assert sched["_class_name"] == "DPMSolverMultistepScheduler"
+    assert sched["steps_offset"] == 1
+
+    # npz fast path unchanged
+    assert CV.check_converted(up, import_hf_layout(tmp_path / "ckpt", "unet")) == []
+
+    # safetensors -> forward converter -> identical tree
+    sd = load_file(str(tmp_path / "ckpt" / "unet" /
+                       "diffusion_pytorch_model.safetensors"))
+    back = CV.convert_unet(sd, block_out_channels=cfg.block_out_channels,
+                           layers_per_block=cfg.layers_per_block,
+                           transformer_layers=cfg.transformer_layers)
+    assert CV.check_converted(up, back) == []
+    for (p1, a), (p2, b) in zip(sorted(EX._leaves(up)), sorted(EX._leaves(back))):
+        assert p1 == p2
+        np.testing.assert_array_equal(a, b, err_msg=p1)
+
+    sd_vae = load_file(str(tmp_path / "ckpt" / "vae" /
+                           "diffusion_pytorch_model.safetensors"))
+    assert any(".query.weight" in k for k in sd_vae)   # 0.14-era naming
+    back_vae = CV.convert_vae(sd_vae, block_out_channels=cfg.vae_block_out_channels,
+                              layers_per_block=cfg.vae_layers_per_block)
+    assert CV.check_converted(vp, back_vae) == []
+
+
+# ---------------------------------------------------------------------------
+# CLIP image tower converter (VERDICT round-1 item 5)
+# ---------------------------------------------------------------------------
+
+def test_clip_image_converter_parity_with_transformers():
+    """REAL cross-framework parity: transformers CLIPVisionModelWithProjection
+    (torch) -> convert_clip_image -> identical image embeddings."""
+    torch = pytest.importorskip("torch")
+    from transformers import CLIPVisionConfig, CLIPVisionModelWithProjection
+
+    from dcr_tpu.models.clip_image import CLIPImageTower
+
+    hf_cfg = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=16,
+        hidden_act="quick_gelu", projection_dim=16)
+    torch.manual_seed(0)
+    hf = CLIPVisionModelWithProjection(hf_cfg).eval()
+    sd = CV.torch_state_dict_to_numpy(hf)
+
+    tower = CLIPImageTower(patch_size=16, width=32, layers=2, heads=2,
+                           embed_dim=16)
+    converted = CV.convert_clip_image(sd, layers=2)
+    init = tower.init(jax.random.key(0), np.zeros((1, 32, 32, 3)))["params"]
+    problems = CV.check_converted(init, converted)
+    assert not problems, problems[:10]
+
+    rng = np.random.default_rng(0)
+    x01 = rng.uniform(0.2, 0.8, (2, 32, 32, 3)).astype(np.float32)
+    mean = np.array([0.48145466, 0.4578275, 0.40821073], np.float32)
+    std = np.array([0.26862954, 0.26130258, 0.27577711], np.float32)
+    x_norm = (x01 - mean) / std
+    with torch.no_grad():
+        ref = hf(pixel_values=torch.from_numpy(
+            x_norm.transpose(0, 3, 1, 2))).image_embeds.numpy()
+    import jax.numpy as jnp
+
+    out = tower.apply({"params": jax.tree.map(jnp.asarray, converted)},
+                      jnp.asarray(x01))
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)
+
+
+def test_openai_clip_structural_roundtrip():
+    """OpenAI CLIP archive naming (visual.* fused in_proj + text resblocks) ->
+    full scorer params with matching structure."""
+    from dcr_tpu.models.clip_image import (CLIPImageTower, clip_b16_text_config,
+                                           init_clip_scorer, make_clip_scorer)
+
+    width, layers, heads, embed = 32, 2, 2, 16
+    tw, tl, th = 32, 2, 2
+    sd: dict[str, np.ndarray] = {}
+    z = lambda *s: np.zeros(s, np.float32)
+    sd["visual.conv1.weight"] = z(width, 3, 16, 16)
+    sd["visual.class_embedding"] = z(width)
+    sd["visual.positional_embedding"] = z(5, width)    # 2x2 grid + cls
+    sd["visual.ln_pre.weight"] = z(width); sd["visual.ln_pre.bias"] = z(width)
+    for i in range(layers):
+        p = f"visual.transformer.resblocks.{i}"
+        sd[f"{p}.ln_1.weight"] = z(width); sd[f"{p}.ln_1.bias"] = z(width)
+        sd[f"{p}.attn.in_proj_weight"] = z(3 * width, width)
+        sd[f"{p}.attn.in_proj_bias"] = z(3 * width)
+        sd[f"{p}.attn.out_proj.weight"] = z(width, width)
+        sd[f"{p}.attn.out_proj.bias"] = z(width)
+        sd[f"{p}.ln_2.weight"] = z(width); sd[f"{p}.ln_2.bias"] = z(width)
+        sd[f"{p}.mlp.c_fc.weight"] = z(4 * width, width)
+        sd[f"{p}.mlp.c_fc.bias"] = z(4 * width)
+        sd[f"{p}.mlp.c_proj.weight"] = z(width, 4 * width)
+        sd[f"{p}.mlp.c_proj.bias"] = z(width)
+    sd["visual.ln_post.weight"] = z(width); sd["visual.ln_post.bias"] = z(width)
+    sd["visual.proj"] = z(width, embed)
+    sd["token_embedding.weight"] = z(50, tw)
+    sd["positional_embedding"] = z(8, tw)
+    for i in range(tl):
+        p = f"transformer.resblocks.{i}"
+        sd[f"{p}.ln_1.weight"] = z(tw); sd[f"{p}.ln_1.bias"] = z(tw)
+        sd[f"{p}.attn.in_proj_weight"] = z(3 * tw, tw)
+        sd[f"{p}.attn.in_proj_bias"] = z(3 * tw)
+        sd[f"{p}.attn.out_proj.weight"] = z(tw, tw)
+        sd[f"{p}.attn.out_proj.bias"] = z(tw)
+        sd[f"{p}.ln_2.weight"] = z(tw); sd[f"{p}.ln_2.bias"] = z(tw)
+        sd[f"{p}.mlp.c_fc.weight"] = z(4 * tw, tw)
+        sd[f"{p}.mlp.c_fc.bias"] = z(4 * tw)
+        sd[f"{p}.mlp.c_proj.weight"] = z(tw, 4 * tw)
+        sd[f"{p}.mlp.c_proj.bias"] = z(tw)
+    sd["ln_final.weight"] = z(tw); sd["ln_final.bias"] = z(tw)
+    sd["text_projection"] = z(tw, embed)
+
+    params = CV.convert_openai_clip(sd, image_layers=layers,
+                                    text_layers=tl, text_heads=th)
+    tower = CLIPImageTower(patch_size=16, width=width, layers=layers,
+                           heads=heads, embed_dim=embed)
+    img_init = tower.init(jax.random.key(0), np.zeros((1, 32, 32, 3)))["params"]
+    assert CV.check_converted(img_init, params["image"]) == []
+
+    import dataclasses
+
+    from dcr_tpu.models.clip_text import CLIPTextModel
+
+    tcfg = dataclasses.replace(clip_b16_text_config(vocab_size=50),
+                               text_hidden_size=tw, text_layers=tl,
+                               text_heads=th, text_max_length=8)
+    text_init = CLIPTextModel(tcfg).init(
+        jax.random.key(1), np.zeros((1, 8), np.int32))["params"]
+    assert CV.check_converted(text_init, params["text"]) == []
+    assert params["text_projection"].shape == (tw, embed)

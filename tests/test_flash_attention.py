@@ -25,6 +25,25 @@ def test_supported_shapes():
     assert not FA.supported(q4, k4, v4)
 
 
+def test_dispatch_policy():
+    """should_use = capability AND the measured win threshold (FLASH_MIN_SEQ):
+    short sequences go to XLA even though the kernel could run them."""
+    q, k, v = _rand_qkv(jax.random.key(0), sq=512, sk=512)
+    assert FA.supported(q, k, v) and not FA.should_use(q, k, v)
+    ql, kl, vl = _rand_qkv(jax.random.key(0), sq=FA.FLASH_MIN_SEQ,
+                           sk=FA.FLASH_MIN_SEQ)
+    assert FA.should_use(ql, kl, vl)
+
+
+def test_block_resolution():
+    """Explicit blocks win; defaults clamp to divide the sequence lengths."""
+    assert FA._resolve_blocks(4096, 4096, 256, 128) == (256, 128)
+    bq, bk = FA._resolve_blocks(1024, 1024, None, None)
+    assert 1024 % bq == 0 and 1024 % bk == 0
+    bq, bk = FA._resolve_blocks(384, 384, None, None)  # 384 = 3*128
+    assert 384 % bq == 0 and 384 % bk == 0
+
+
 def test_flash_matches_xla_forward():
     q, k, v = _rand_qkv(jax.random.key(1))
     ref = A.dot_product_attention(q, k, v, use_flash=False)

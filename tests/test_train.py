@@ -258,3 +258,41 @@ def test_tensor_parallel_train_step_matches_dp(setup, cpu_devices):
         s_tp, pmesh.shard_batch(mesh_tp, raw), key)
     np.testing.assert_allclose(float(m_dp["loss"]), float(m_tp["loss"]), rtol=1e-5)
     assert int(jax.device_get(s_tp.step)) == 1
+
+
+def test_ring_attention_seq_parallel_train_step(setup, cpu_devices):
+    """Ring attention wired into the UNet (VERDICT round-1 item 7): a seq=2
+    mesh trains one step at doubled resolution with the ring path active, and
+    the loss matches the dense seq=1 run on the same params/batch."""
+    import dataclasses
+
+    cfg0, _, params = setup
+    cfg = _cfg()
+    # 16px latents -> S=256 top-level spatial attention; threshold 64 puts
+    # every self-attention on the ring path
+    cfg.model = dataclasses.replace(ModelConfig.tiny(), seq_parallel_min_seq=64)
+    key = rngmod.root_key(0)
+    px = 16 * 2 ** (len(cfg.model.vae_block_out_channels) - 1)
+    batch = {
+        "pixel_values": jax.random.uniform(jax.random.key(5), (8, px, px, 3)) * 2 - 1,
+        "input_ids": jax.random.randint(jax.random.key(6),
+                                        (8, cfg.model.text_max_length), 0,
+                                        cfg.model.text_vocab_size),
+    }
+
+    losses = {}
+    for name, mesh_cfg in (("dense", MeshConfig(data=-1)),
+                           ("ring", MeshConfig(data=-1, fsdp=1, tensor=1, seq=2))):
+        mesh = pmesh.make_mesh(mesh_cfg)
+        models, p = build_models(cfg, jax.random.key(0), mesh=mesh)
+        p = {k: jax.tree.map(lambda x: jnp.array(np.asarray(x)), params[k])
+             for k in p}  # same weights for both runs
+        state = T.init_train_state(cfg, models, unet_params=p["unet"],
+                                   text_params=p["text"], vae_params=p["vae"])
+        state = T.shard_train_state(state, mesh)
+        step = T.make_train_step(cfg, models, mesh)
+        state, m = step(state, pmesh.shard_batch(mesh, batch), key)
+        losses[name] = float(jax.device_get(m["loss"]))
+        assert np.isfinite(losses[name])
+    np.testing.assert_allclose(losses["ring"], losses["dense"],
+                               rtol=1e-5, atol=1e-5)

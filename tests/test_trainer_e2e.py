@@ -179,3 +179,25 @@ def test_config_file_presets_load():
     assert full.train_batch_size == 16
     assert full.optim.lr_warmup_steps == 5000
     assert full.model.block_out_channels == (320, 640, 1280, 1280)
+
+
+def test_sync_step_cadence_with_grad_accum(train_setup):
+    """With gradient accumulation N, the observable cadences (save_steps /
+    modelsavesteps / max_train_steps) count optimizer (sync) steps — the
+    reference's accelerate global_step semantics (diff_train.py:669) — while
+    internal counting stays in micro-steps."""
+    import jax
+
+    cfg, tmp_path = train_setup
+    cfg.output_dir = str(tmp_path / "run_accum")
+    cfg.optim.gradient_accumulation_steps = 2
+    cfg.max_train_steps = 4          # sync steps -> 8 micro-steps
+    cfg.modelsavesteps = 2           # saves after sync steps 2 and 4
+    cfg.save_steps = 3               # sample hook fires at sync step 3 only
+    hook_calls = []
+    trainer = Trainer(cfg, sample_hook=lambda tr, s: hook_calls.append(s))
+    trainer.train()
+    assert int(jax.device_get(trainer.state.step)) == 8
+    steps = trainer.ckpt.all_steps()  # checkpoint labels stay micro-step
+    assert 4 in steps and 8 in steps
+    assert hook_calls == [3]
