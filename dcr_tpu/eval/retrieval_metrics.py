@@ -63,6 +63,56 @@ def mean_reciprocal_rank(sim: np.ndarray, relevance: np.ndarray) -> float:
     return float(np.mean(rr))
 
 
+def compute_map_revisited(ranks: np.ndarray, gnd: Sequence[dict],
+                          kappas: Sequence[int] = ()) -> tuple:
+    """Revisited-Oxford-style mAP with junk filtering, matching the exact
+    semantics of the reference toolkit (utils_ret.py:322-417) — trapezoidal AP,
+    P@k with the k := min(max(rank), k) clamp, recall@k over true matches, and
+    MRR computed pre-junk-adjustment and averaged over *all* queries.
+    Verified against the executed reference in tests/test_reference_parity.py.
+
+    ranks: [db_size, n_queries] of 0-based db ids, best first.
+    gnd: per query {"ok": ids, "junk": ids}. Queries with no positives are
+    excluded from mAP/P@k/recall (but still dilute MRR, as in the reference).
+    Returns (mAP, P@kappas, recall@kappas, MRR).
+    """
+    kappas = list(kappas)
+    n_q = len(gnd)
+    ap_sum, n_empty, mrr = 0.0, 0, 0.0
+    pr_sum = np.zeros(len(kappas))
+    recalls = []
+    for q in range(n_q):
+        ok = np.asarray(gnd[q].get("ok", ()), dtype=np.int64)
+        if ok.size == 0:
+            n_empty += 1
+            continue
+        junk = np.asarray(gnd[q].get("junk", ()), dtype=np.int64)
+        ranked = ranks[:, q]
+        pos = np.flatnonzero(np.isin(ranked, ok))
+        junk_pos = np.flatnonzero(np.isin(ranked, junk))
+        mrr += 1.0 / (pos.min() + 1)
+        # drop junk entries from the ranking: each positive moves up by the
+        # number of junk results ranked above it
+        if junk_pos.size:
+            pos = pos - np.searchsorted(junk_pos, pos)
+        # trapezoidal AP over the precision-recall curve
+        j = np.arange(pos.size, dtype=np.float64)
+        prec_before = np.where(pos == 0, 1.0, j / np.maximum(pos, 1))
+        prec_at = (j + 1) / (pos + 1)
+        ap_sum += float(np.sum(prec_before + prec_at)) / (2.0 * ok.size)
+        pos1 = pos + 1                                    # 1-based
+        row = []
+        for i, k in enumerate(kappas):
+            kq = min(pos1.max(), k)
+            pr_sum[i] += float(np.sum(pos1 <= kq)) / kq
+            row.append(float(np.sum(pos1 <= k)) / ok.size)
+        recalls.append(row)
+    n_eval = max(n_q - n_empty, 1)
+    recs = (np.mean(np.asarray(recalls), axis=0) if recalls
+            else np.full(len(kappas), np.nan))
+    return ap_sum / n_eval, pr_sum / n_eval, recs, mrr / n_q
+
+
 def retrieval_report(sim: np.ndarray, relevance: np.ndarray,
                      ks: Sequence[int] = (1, 5, 10)) -> dict:
     out = {"mAP": mean_average_precision(sim, relevance),

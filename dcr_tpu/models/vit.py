@@ -64,20 +64,48 @@ class ViTBlock(nn.Module):
         return x + h
 
 
+def _bicubic_axis(out_size: int, in_size: int, scale: float):
+    """Tap indices [4, out] and weights [4, out] for one axis of torch's
+    `F.interpolate(mode="bicubic", align_corners=False, scale_factor=scale)`:
+    source coord (dst+0.5)/scale - 0.5, cubic-convolution kernel A=-0.75,
+    border-clamped taps. Computed host-side (static shapes) at trace time."""
+    import numpy as np
+
+    a = -0.75
+    k_near = lambda x: ((a + 2) * x - (a + 3)) * x * x + 1        # |x| <= 1
+    k_far = lambda x: ((a * x - 5 * a) * x + 8 * a) * x - 4 * a   # 1 < |x| < 2
+    src = (np.arange(out_size) + 0.5) / scale - 0.5
+    i0 = np.floor(src).astype(np.int64)
+    t = src - i0
+    weights = np.stack([k_far(t + 1.0), k_near(t), k_near(1.0 - t),
+                        k_far(2.0 - t)])
+    idx = np.stack([i0 - 1, i0, i0 + 1, i0 + 2]).clip(0, in_size - 1)
+    return idx, weights
+
+
 def interpolate_pos_embed(pos_embed: jax.Array, num_patches: int,
                           grid_hw: tuple[int, int]) -> jax.Array:
-    """Bicubic interpolation of the patch position table to a new grid
-    (capability of reference dino_vits.py:213-233) — lets one checkpoint serve
-    any input resolution."""
+    """Bicubic interpolation of the patch position table to a new grid —
+    lets one checkpoint serve any input resolution. Numerically identical to
+    the reference's torch path (dino_vits.py:213-233: scale factors carry the
+    +0.1 anti-rounding nudge and feed the coordinate mapping directly);
+    verified against executed reference code in tests/test_reference_parity.py."""
     cls_pos, patch_pos = pos_embed[:, :1], pos_embed[:, 1:]
     n_orig = patch_pos.shape[1]
-    if n_orig == num_patches:
+    h, w = grid_hw
+    # a non-square grid must interpolate even at matching patch count — the
+    # table is laid out square (reference condition dino_vits.py:216)
+    if n_orig == num_patches and h == w:
         return pos_embed
     side = int(math.sqrt(n_orig))
-    h, w = grid_hw
-    grid = patch_pos.reshape(1, side, side, -1)
-    grid = jax.image.resize(grid, (1, h, w, grid.shape[-1]), method="cubic")
-    return jnp.concatenate([cls_pos, grid.reshape(1, h * w, -1)], axis=1)
+    grid = patch_pos.reshape(side, side, -1)
+    iy, wy = _bicubic_axis(h, side, (h + 0.1) / side)
+    ix, wx = _bicubic_axis(w, side, (w + 0.1) / side)
+    wy = jnp.asarray(wy, grid.dtype)
+    wx = jnp.asarray(wx, grid.dtype)
+    rows = jnp.einsum("kh,khsd->hsd", wy, grid[iy])        # [h, side, D]
+    out = jnp.einsum("kw,hkwd->hwd", wx, rows[:, ix])      # [h, w, D]
+    return jnp.concatenate([cls_pos, out.reshape(1, h * w, -1)], axis=1)
 
 
 class VisionTransformer(nn.Module):
@@ -86,6 +114,9 @@ class VisionTransformer(nn.Module):
     depth: int = 12
     num_heads: int = 12
     mlp_ratio: float = 4.0
+    # sizes the positional table, like the reference's img_size arg
+    # (dino_vits.py:176-187); other input sizes interpolate from it
+    img_size: int = 224
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -99,7 +130,7 @@ class VisionTransformer(nn.Module):
         tokens = PatchEmbed(self.patch_size, self.embed_dim, dtype=self.dtype,
                             name="patch_embed")(x)
         cls = self.param("cls_token", nn.initializers.zeros, (1, 1, self.embed_dim))
-        max_grid = 224 // self.patch_size
+        max_grid = self.img_size // self.patch_size
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, max_grid * max_grid + 1, self.embed_dim))
         pos = interpolate_pos_embed(pos, gh * gw, (gh, gw))

@@ -1,0 +1,103 @@
+"""Parity against activations recorded from the EXECUTED torch reference.
+
+tests/goldens/{dino,retrieval_metrics}_reference.npz are produced by
+tools/gen_reference_fixtures.py, which imports /root/reference/dino_vits.py
+and /root/reference/utils_ret.py and runs them as numerical oracles
+(SURVEY.md §4 item 2). These tests prove cross-framework parity of:
+
+- the DINO VisionTransformer (reference dino_vits.py:171-275) against
+  models/vit.py + convert.convert_dino_vit, including the bicubic
+  positional-embedding interpolation path (dino_vits.py:213-233) and
+  get_intermediate_layers (267-275);
+- the retrieval-metric toolkit (utils_ret.py:322-417) against
+  eval/retrieval_metrics.compute_map_revisited.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+GOLD = Path(__file__).parent / "goldens"
+
+
+@pytest.fixture(scope="module")
+def dino_ref():
+    data = np.load(GOLD / "dino_reference.npz")
+    sd = {k[len("sd/"):]: data[k] for k in data.files if k.startswith("sd/")}
+    return data, sd
+
+
+@pytest.fixture(scope="module")
+def dino_params(dino_ref):
+    from dcr_tpu.models.convert import convert_dino_vit
+
+    _, sd = dino_ref
+    return {"params": convert_dino_vit(sd, depth=3)}
+
+
+def _model():
+    from dcr_tpu.models.vit import VisionTransformer
+
+    return VisionTransformer(patch_size=8, embed_dim=64, depth=3, num_heads=2,
+                             img_size=32)
+
+
+def _nhwc(x):
+    return np.transpose(x, (0, 2, 3, 1))
+
+
+def test_dino_vit_matches_reference_native(dino_ref, dino_params):
+    data, _ = dino_ref
+    out = _model().apply(dino_params, _nhwc(data["x_native"]))
+    np.testing.assert_allclose(np.asarray(out), data["out_native"],
+                               atol=7e-5, rtol=5e-4)
+
+
+def test_dino_vit_matches_reference_interpolated(dino_ref, dino_params):
+    """48px input against a 32px pos table exercises the bicubic
+    interpolation path end to end (reference dino_vits.py:213-233)."""
+    data, _ = dino_ref
+    out = _model().apply(dino_params, _nhwc(data["x_interp"]))
+    np.testing.assert_allclose(np.asarray(out), data["out_interp"],
+                               atol=7e-5, rtol=5e-4)
+
+
+def test_dino_vit_matches_reference_nonsquare_same_count(dino_ref, dino_params):
+    """16x64 input has a 2x8 grid whose patch count equals the 4x4 table's —
+    the reference interpolates anyway because the grid is non-square
+    (dino_vits.py:216); skipping would silently misplace every embedding."""
+    data, _ = dino_ref
+    out = _model().apply(dino_params, _nhwc(data["x_rect"]))
+    np.testing.assert_allclose(np.asarray(out), data["out_rect"],
+                               atol=7e-5, rtol=5e-4)
+
+
+def test_dino_vit_matches_reference_intermediate_layers(dino_ref, dino_params):
+    data, _ = dino_ref
+    outs = _model().apply(dino_params, _nhwc(data["x_native"]),
+                          return_layers=2)
+    assert len(outs) == 2
+    np.testing.assert_allclose(np.asarray(outs[0]), data["inter_0"],
+                               atol=7e-5, rtol=5e-4)
+    np.testing.assert_allclose(np.asarray(outs[1]), data["inter_1"],
+                               atol=7e-5, rtol=5e-4)
+
+
+def test_compute_map_matches_reference():
+    from dcr_tpu.eval.retrieval_metrics import compute_map_revisited
+
+    data = np.load(GOLD / "retrieval_metrics_reference.npz")
+    gnd = []
+    for q in range(data["ok"].shape[0]):
+        ok = [int(i) for i in data["ok"][q] if i >= 0]
+        junk = [int(i) for i in data["junk"][q] if i >= 0]
+        gnd.append({"ok": ok, "junk": junk})
+    m, pr, recs, mrr = compute_map_revisited(
+        data["ranks"], gnd, [int(k) for k in data["kappas"]])
+    assert m == pytest.approx(float(data["map"]), abs=1e-12)
+    assert mrr == pytest.approx(float(data["mrr"]), abs=1e-12)
+    np.testing.assert_allclose(pr, data["pr"], atol=1e-12)
+    np.testing.assert_allclose(recs, data["recs"], atol=1e-12)

@@ -1,0 +1,123 @@
+"""Generate golden fixtures by EXECUTING the torch reference at /root/reference.
+
+SURVEY.md §4 item 2 demands model-parity goldens "checked against recorded
+activations from the torch reference". The reference's DINO ViT
+(dino_vits.py) and retrieval-metric toolkit (utils_ret.py:300-417) are
+torch/numpy-only, so they run in this image: this script imports them,
+drives them with seeded random weights/inputs at small shapes, and records
+state dicts + activations into tests/goldens/*.npz. No reference code is
+copied — it is executed as a numerical oracle.
+
+utils_ret.py imports dead/unavailable modules at top level
+(`torch._six`, torchvision — SURVEY.md §2.4); those are stubbed with empty
+modules so the pure-numpy functions under test are reachable.
+
+Usage: python tools/gen_reference_fixtures.py
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib.util
+import math
+import sys
+import types
+from pathlib import Path
+
+import numpy as np
+import torch
+
+REF = Path("/root/reference")
+GOLD = Path(__file__).resolve().parent.parent / "tests" / "goldens"
+
+
+def load_ref_module(name: str, path: Path):
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _stub(name: str, **attrs) -> None:
+    mod = types.ModuleType(name)
+    for k, v in attrs.items():
+        setattr(mod, k, v)
+    sys.modules.setdefault(name, mod)
+
+
+def gen_dino() -> None:
+    dv = load_ref_module("ref_dino_vits", REF / "dino_vits.py")
+    torch.manual_seed(0)
+    # Tiny instance of the reference VisionTransformer: same class, same
+    # qkv_bias/eps settings as its vit_* constructors (dino_vits.py:278-296),
+    # scaled down so the fixture stays <1 MB.
+    model = dv.VisionTransformer(
+        img_size=[32], patch_size=8, in_chans=3, num_classes=0,
+        embed_dim=64, depth=3, num_heads=2, mlp_ratio=4.0, qkv_bias=True,
+        norm_layer=functools.partial(torch.nn.LayerNorm, eps=1e-6))
+    model.eval()
+
+    g = torch.Generator().manual_seed(1234)
+    x_native = torch.randn(2, 3, 32, 32, generator=g)       # 4x4 grid == table
+    x_interp = torch.randn(2, 3, 48, 48, generator=g)       # 6x6 grid -> bicubic
+    # non-square with the SAME patch count as the table (2x8 = 16): the
+    # reference still interpolates because w != h (dino_vits.py:216)
+    x_rect = torch.randn(2, 3, 16, 64, generator=g)
+    with torch.no_grad():
+        out_native = model(x_native)
+        out_interp = model(x_interp)
+        out_rect = model(x_rect)
+        inter = model.get_intermediate_layers(x_native, n=2)
+
+    arrays = {f"sd/{k}": v.numpy() for k, v in model.state_dict().items()}
+    arrays.update(
+        x_native=x_native.numpy(), x_interp=x_interp.numpy(),
+        x_rect=x_rect.numpy(),
+        out_native=out_native.numpy(), out_interp=out_interp.numpy(),
+        out_rect=out_rect.numpy(),
+        inter_0=inter[0].numpy(), inter_1=inter[1].numpy())
+    out = GOLD / "dino_reference.npz"
+    np.savez_compressed(out, **arrays)
+    print(f"wrote {out} ({out.stat().st_size/1e3:.0f} kB)")
+
+
+def gen_retrieval_metrics() -> None:
+    _stub("torch._six", inf=math.inf)
+    _stub("torchvision")
+    _stub("torchvision.transforms")
+    _stub("natsort", natsorted=sorted)
+    _stub("clip", tokenize=lambda *a, **k: None)
+    ur = load_ref_module("ref_utils_ret", REF / "utils_ret.py")
+
+    rng = np.random.default_rng(7)
+    n_db, n_q = 40, 6
+    sim = rng.standard_normal((n_db, n_q))
+    ranks = np.argsort(-sim, axis=0)                        # [db, q], 0-based
+    gnd = []
+    for q in range(n_q):
+        n_ok = int(rng.integers(1, 6))
+        perm = rng.permutation(n_db)
+        ok = perm[:n_ok]
+        junk = perm[n_ok:n_ok + int(rng.integers(0, 4))]
+        gnd.append({"ok": ok.tolist(), "junk": junk.tolist()})
+    kappas = [1, 5, 10]
+    m, pr, recs, mrr = ur.compute_map(ranks, gnd, kappas)
+
+    pad = max(len(g["ok"]) + len(g["junk"]) for g in gnd)
+    ok_arr = np.full((n_q, pad), -1); junk_arr = np.full((n_q, pad), -1)
+    for q, gq in enumerate(gnd):
+        ok_arr[q, :len(gq["ok"])] = gq["ok"]
+        junk_arr[q, :len(gq["junk"])] = gq["junk"]
+    out = GOLD / "retrieval_metrics_reference.npz"
+    np.savez_compressed(out, sim=sim, ranks=ranks, ok=ok_arr, junk=junk_arr,
+                        kappas=np.array(kappas), map=np.float64(m),
+                        pr=np.asarray(pr), recs=np.asarray(recs),
+                        mrr=np.float64(mrr))
+    print(f"wrote {out}: map={m:.6f} mrr={mrr:.6f} pr={pr} recs={recs}")
+
+
+if __name__ == "__main__":
+    GOLD.mkdir(exist_ok=True)
+    gen_dino()
+    gen_retrieval_metrics()
