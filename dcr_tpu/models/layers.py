@@ -208,7 +208,9 @@ class Transformer2D(nn.Module):
         b, h, w, c = x.shape
         residual = x
         inner = self.num_heads * self.head_dim
-        out = GroupNorm(self.num_groups, name="norm")(x)
+        # diffusers Transformer2DModel norms with eps=1e-6 (unlike the 1e-5
+        # resnet norms); mismatch silently drifts converted SD weights
+        out = GroupNorm(self.num_groups, epsilon=1e-6, name="norm")(x)
         out = out.reshape(b, h * w, c)
         out = nn.Dense(inner, dtype=self.dtype, name="proj_in")(out)
         for i in range(self.num_layers):

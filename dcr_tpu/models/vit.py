@@ -27,8 +27,11 @@ class PatchEmbed(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         p = self.patch_size
-        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), dtype=self.dtype,
-                    name="proj")(x)
+        # VALID: non-divisible inputs floor to the same grid the torch
+        # reference's padding-0 Conv2d produces (SAME would emit ceil and
+        # desync from the positional table)
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="proj")(x)
         b, h, w, c = x.shape
         return x.reshape(b, h * w, c)
 

@@ -1,0 +1,270 @@
+"""Torch transcriptions of the eval-backbone architectures (torchvision naming).
+
+The reference's copy-detection/metric backbones ship as torch checkpoints:
+SSCD TorchScript resnet50 (diff_retrieval.py:277-285), torchvision VGG16
+(metrics/ipr.py:41), pt_inception-2015-12-05 (metrics/inception.py:219).
+torchvision is not installed here, so these modules re-create the exact
+architectures + state-dict naming in plain torch; tests/test_torch_parity.py
+seeds them, feeds their state dicts through models/convert.py, and checks
+Flax activations against the torch forwards — cross-framework parity with
+the checkpoint-source layout (NCHW convs, eval-mode BatchNorm, torch
+maxpool semantics).
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+class Bottleneck(nn.Module):
+    """torchvision resnet50 v1.5 bottleneck: stride on the 3x3 conv."""
+
+    def __init__(self, in_ch: int, mid: int, stride: int = 1):
+        super().__init__()
+        out = mid * 4
+        self.conv1 = nn.Conv2d(in_ch, mid, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(mid)
+        self.conv2 = nn.Conv2d(mid, mid, 3, stride=stride, padding=1, bias=False)
+        self.bn2 = nn.BatchNorm2d(mid)
+        self.conv3 = nn.Conv2d(mid, out, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(out)
+        if stride != 1 or in_ch != out:
+            self.downsample = nn.Sequential(
+                nn.Conv2d(in_ch, out, 1, stride=stride, bias=False),
+                nn.BatchNorm2d(out))
+
+    def forward(self, x):
+        h = F.relu(self.bn1(self.conv1(x)))
+        h = F.relu(self.bn2(self.conv2(h)))
+        h = self.bn3(self.conv3(h))
+        skip = self.downsample(x) if hasattr(self, "downsample") else x
+        return F.relu(h + skip)
+
+
+class TorchResNet50(nn.Module):
+    """torchvision resnet50 trunk (conv1..layer4), no head."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = nn.Conv2d(3, 64, 7, stride=2, padding=3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        mid, in_ch = 64, 64
+        for stage, blocks in enumerate((3, 4, 6, 3), start=1):
+            layers = []
+            for b in range(blocks):
+                layers.append(Bottleneck(in_ch, mid,
+                                         stride=2 if stage > 1 and b == 0 else 1))
+                in_ch = mid * 4
+            setattr(self, f"layer{stage}", nn.Sequential(*layers))
+            mid *= 2
+
+    def forward(self, x):
+        h = F.relu(self.bn1(self.conv1(x)))
+        h = F.max_pool2d(h, 3, stride=2, padding=1)
+        for stage in (1, 2, 3, 4):
+            h = getattr(self, f"layer{stage}")(h)
+        return h
+
+
+class TorchSSCD(nn.Module):
+    """SSCD descriptor: resnet50 trunk (`backbone.`) -> GeM(p=3) -> Linear
+    (`embeddings.`), the TorchScript archive's structure."""
+
+    def __init__(self, embed_dim: int = 512):
+        super().__init__()
+        self.backbone = TorchResNet50()
+        self.embeddings = nn.Linear(2048, embed_dim)
+
+    def forward(self, x, p: float = 3.0, eps: float = 1e-6):
+        h = self.backbone(x)
+        pooled = h.clamp(min=eps).pow(p).mean(dim=(2, 3)).pow(1.0 / p)
+        return self.embeddings(pooled)
+
+
+class BasicConv2d(nn.Module):
+    """conv(bias=False) + BN(eps=1e-3) + relu — the Inception cell, named
+    `conv`/`bn` like the pt_inception-2015-12-05 checkpoint."""
+
+    def __init__(self, in_ch: int, out_ch: int, **kw):
+        super().__init__()
+        self.conv = nn.Conv2d(in_ch, out_ch, bias=False, **kw)
+        self.bn = nn.BatchNorm2d(out_ch, eps=1e-3)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3_exclude_pad(x):
+    """TF-FID average pool: 3x3/1 pad 1, padding excluded from the divisor."""
+    return F.avg_pool2d(x, 3, stride=1, padding=1, count_include_pad=False)
+
+
+class IncA(nn.Module):
+    def __init__(self, in_ch: int, pool: int):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch5x5_1 = BasicConv2d(in_ch, 48, kernel_size=1)
+        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = BasicConv2d(in_ch, pool, kernel_size=1)
+
+    def forward(self, x):
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return torch.cat([self.branch1x1(x), b5, bd,
+                          self.branch_pool(_avg3_exclude_pad(x))], 1)
+
+
+class IncB(nn.Module):
+    def __init__(self, in_ch: int):
+        super().__init__()
+        self.branch3x3 = BasicConv2d(in_ch, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        return torch.cat([self.branch3x3(x), bd,
+                          F.max_pool2d(x, 3, stride=2)], 1)
+
+
+class IncC(nn.Module):
+    def __init__(self, in_ch: int, c7: int):
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_1(x)
+        bd = self.branch7x7dbl_3(self.branch7x7dbl_2(bd))
+        bd = self.branch7x7dbl_5(self.branch7x7dbl_4(bd))
+        return torch.cat([self.branch1x1(x), b7, bd,
+                          self.branch_pool(_avg3_exclude_pad(x))], 1)
+
+
+class IncD(nn.Module):
+    def __init__(self, in_ch: int):
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(
+            self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        return torch.cat([b3, b7, F.max_pool2d(x, 3, stride=2)], 1)
+
+
+class IncE(nn.Module):
+    def __init__(self, in_ch: int, pool_mode: str):
+        super().__init__()
+        self.pool_mode = pool_mode
+        self.branch1x1 = BasicConv2d(in_ch, 320, kernel_size=1)
+        self.branch3x3_1 = BasicConv2d(in_ch, 384, kernel_size=1)
+        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 448, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool_mode == "max":        # Mixed_7c FID quirk
+            bp = F.max_pool2d(x, 3, stride=1, padding=1)
+        else:
+            bp = _avg3_exclude_pad(x)
+        return torch.cat([self.branch1x1(x), b3, bd, self.branch_pool(bp)], 1)
+
+
+class TorchInceptionFID(nn.Module):
+    """pt_inception-2015-12-05 network sliced at pool3 (2048-d), with the
+    TF-faithful pooling quirks (reference metrics/inception.py:224-341).
+    Input in [0,1]; resized to 299 and scaled to (-1,1) like the reference's
+    wrapper (metrics/inception.py:146-153)."""
+
+    def __init__(self):
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = IncA(192, 32)
+        self.Mixed_5c = IncA(256, 64)
+        self.Mixed_5d = IncA(288, 64)
+        self.Mixed_6a = IncB(288)
+        self.Mixed_6b = IncC(768, 128)
+        self.Mixed_6c = IncC(768, 160)
+        self.Mixed_6d = IncC(768, 160)
+        self.Mixed_6e = IncC(768, 192)
+        self.Mixed_7a = IncD(768)
+        self.Mixed_7b = IncE(1280, "avg")
+        self.Mixed_7c = IncE(2048, "max")
+
+    def forward(self, x, resize_input: bool = True):
+        if resize_input and x.shape[-1] != 299:
+            x = F.interpolate(x, size=(299, 299), mode="bilinear",
+                              align_corners=False)
+        x = 2.0 * x - 1.0
+        x = self.Conv2d_2b_3x3(self.Conv2d_2a_3x3(self.Conv2d_1a_3x3(x)))
+        x = F.max_pool2d(x, 3, stride=2)
+        x = self.Conv2d_4a_3x3(self.Conv2d_3b_1x1(x))
+        x = F.max_pool2d(x, 3, stride=2)
+        for name in ("Mixed_5b", "Mixed_5c", "Mixed_5d", "Mixed_6a",
+                     "Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e",
+                     "Mixed_7a", "Mixed_7b", "Mixed_7c"):
+            x = getattr(self, name)(x)
+        return x.mean(dim=(2, 3))
+
+
+class TorchVGG16(nn.Module):
+    """torchvision vgg16 features + first two classifier linears, exact
+    Sequential index naming (features.0..28, classifier.0/.3)."""
+
+    def __init__(self):
+        super().__init__()
+        plan = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+                512, 512, 512, "M", 512, 512, 512, "M")
+        mods, in_ch = [], 3
+        for item in plan:
+            if item == "M":
+                mods.append(nn.MaxPool2d(2, 2))
+            else:
+                mods += [nn.Conv2d(in_ch, int(item), 3, padding=1),
+                         nn.ReLU(inplace=False)]
+                in_ch = int(item)
+        self.features = nn.Sequential(*mods)
+        self.classifier = nn.Sequential(
+            nn.Linear(512 * 7 * 7, 4096), nn.ReLU(), nn.Dropout(),
+            nn.Linear(4096, 4096))
+
+    def forward(self, x):
+        """x in [0,1]; ImageNet-normalized inside (mirrors VGG16Features)."""
+        mean = torch.tensor([0.485, 0.456, 0.406]).view(1, 3, 1, 1)
+        std = torch.tensor([0.229, 0.224, 0.225]).view(1, 3, 1, 1)
+        h = self.features((x - mean) / std)
+        h = torch.flatten(h, 1)
+        return F.relu(self.classifier(h))

@@ -1,0 +1,370 @@
+"""Torch transcriptions of diffusers' UNet2DConditionModel / AutoencoderKL.
+
+Independent torch implementations of the architectures the reference
+finetunes (diff_train.py:370-408 loads them from HF diffusers; diffusers is
+not installed in this image). Module/parameter naming follows the real
+diffusers state-dict layout byte-for-byte (validated against the vendored
+SD-2.1 manifests, tests/fixtures/sd21_*_keys.json), so
+`load_state_dict(..., strict=True)` on tensors produced by
+dcr_tpu.models.export proves the exporter emits genuinely loadable
+checkpoints — and running the loaded model proves cross-framework
+activation parity of the NHWC Flax stack against torch NCHW semantics
+(SURVEY.md §4 item 2, §7.3 "UNet weight-conversion fidelity").
+
+SD-2.x variant: linear transformer projections, GEGLU feed-forward,
+eps=1e-5 resnet norms / 1e-6 transformer+VAE norms, 0.14-era VAE
+AttentionBlock naming (query/key/value/proj_attn).
+"""
+
+from __future__ import annotations
+
+import math
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def timestep_embedding(t: torch.Tensor, dim: int) -> torch.Tensor:
+    """Sinusoidal embedding, flip_sin_to_cos=True, freq_shift=0 (SD config)."""
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half, dtype=torch.float32) / half)
+    args = t.float()[:, None] * freqs[None, :]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+def attention(q: torch.Tensor, k: torch.Tensor, v: torch.Tensor,
+              heads: int) -> torch.Tensor:
+    b, sq, inner = q.shape
+    hd = inner // heads
+    split = lambda x: x.reshape(b, -1, heads, hd).transpose(1, 2)
+    q, k, v = split(q), split(k), split(v)
+    w = torch.softmax(q @ k.transpose(-1, -2) / math.sqrt(hd), dim=-1)
+    return (w @ v).transpose(1, 2).reshape(b, sq, inner)
+
+
+class ResnetBlock2D(nn.Module):
+    def __init__(self, in_ch: int, out_ch: int, temb_ch: int = 0,
+                 groups: int = 32, eps: float = 1e-5):
+        super().__init__()
+        self.norm1 = nn.GroupNorm(groups, in_ch, eps=eps)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        if temb_ch:
+            self.time_emb_proj = nn.Linear(temb_ch, out_ch)
+        self.norm2 = nn.GroupNorm(groups, out_ch, eps=eps)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.conv_shortcut = nn.Conv2d(in_ch, out_ch, 1)
+
+    def forward(self, x, temb=None):
+        h = self.conv1(F.silu(self.norm1(x)))
+        if temb is not None:
+            h = h + self.time_emb_proj(F.silu(temb))[:, :, None, None]
+        h = self.conv2(F.silu(self.norm2(h)))
+        skip = self.conv_shortcut(x) if hasattr(self, "conv_shortcut") else x
+        return h + skip
+
+
+class GEGLU(nn.Module):
+    def __init__(self, dim: int, inner: int):
+        super().__init__()
+        self.proj = nn.Linear(dim, inner * 2)
+
+    def forward(self, x):
+        h, gate = self.proj(x).chunk(2, dim=-1)
+        return h * F.gelu(gate)
+
+
+class CrossAttention(nn.Module):
+    def __init__(self, dim: int, ctx_dim: int, heads: int):
+        super().__init__()
+        self.heads = heads
+        self.to_q = nn.Linear(dim, dim, bias=False)
+        self.to_k = nn.Linear(ctx_dim, dim, bias=False)
+        self.to_v = nn.Linear(ctx_dim, dim, bias=False)
+        self.to_out = nn.ModuleList([nn.Linear(dim, dim)])
+
+    def forward(self, x, ctx=None):
+        ctx = x if ctx is None else ctx
+        out = attention(self.to_q(x), self.to_k(ctx), self.to_v(ctx), self.heads)
+        return self.to_out[0](out)
+
+
+class BasicTransformerBlock(nn.Module):
+    def __init__(self, dim: int, ctx_dim: int, heads: int):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = CrossAttention(dim, dim, heads)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = CrossAttention(dim, ctx_dim, heads)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = nn.Sequential(GEGLU(dim, dim * 4), nn.Identity(),
+                                nn.Linear(dim * 4, dim))
+        # diffusers names: ff.net.0 (GEGLU), ff.net.2 (Linear)
+        self.ff = nn.ModuleDict({"net": self.ff})
+
+    def forward(self, x, ctx):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), ctx)
+        return x + self.ff["net"](self.norm3(x))
+
+
+class Transformer2DModel(nn.Module):
+    """SD-2.x linear-projection spatial transformer."""
+
+    def __init__(self, ch: int, ctx_dim: int, heads: int, layers: int,
+                 groups: int = 32):
+        super().__init__()
+        self.norm = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.proj_in = nn.Linear(ch, ch)
+        self.transformer_blocks = nn.ModuleList(
+            [BasicTransformerBlock(ch, ctx_dim, heads) for _ in range(layers)])
+        self.proj_out = nn.Linear(ch, ch)
+
+    def forward(self, x, ctx):
+        b, c, h, w = x.shape
+        res = x
+        out = self.norm(x).permute(0, 2, 3, 1).reshape(b, h * w, c)
+        out = self.proj_in(out)
+        for blk in self.transformer_blocks:
+            out = blk(out, ctx)
+        out = self.proj_out(out)
+        return out.reshape(b, h, w, c).permute(0, 3, 1, 2) + res
+
+
+class Downsample2D(nn.Module):
+    def __init__(self, ch: int, asymmetric: bool = False):
+        super().__init__()
+        self.asymmetric = asymmetric
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=0 if asymmetric else 1)
+
+    def forward(self, x):
+        if self.asymmetric:                       # diffusers VAE encoder pad
+            x = F.pad(x, (0, 1, 0, 1))
+        return self.conv(x)
+
+
+class Upsample2D(nn.Module):
+    def __init__(self, ch: int):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2.0, mode="nearest"))
+
+
+class _Blockset(nn.Module):
+    """Container matching diffusers' {resnets, attentions, downsamplers,
+    upsamplers} child naming inside each down/up block."""
+
+    def __init__(self, resnets, attentions=None, downsamplers=None,
+                 upsamplers=None):
+        super().__init__()
+        self.resnets = nn.ModuleList(resnets)
+        if attentions is not None:
+            self.attentions = nn.ModuleList(attentions)
+        if downsamplers is not None:
+            self.downsamplers = nn.ModuleList(downsamplers)
+        if upsamplers is not None:
+            self.upsamplers = nn.ModuleList(upsamplers)
+
+
+class TorchUNet2DCondition(nn.Module):
+    """diffusers UNet2DConditionModel (SD-2.x), built from our ModelConfig."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        bo = cfg.block_out_channels
+        n = len(bo)
+        temb_ch = bo[0] * 4
+        hd = cfg.attention_head_dim
+        ctx = cfg.cross_attention_dim
+        lpb = cfg.layers_per_block
+        g = cfg.norm_num_groups
+        self.cfg = cfg
+
+        self.conv_in = nn.Conv2d(cfg.in_channels, bo[0], 3, padding=1)
+        self.time_embedding = nn.ModuleDict({
+            "linear_1": nn.Linear(bo[0], temb_ch),
+            "linear_2": nn.Linear(temb_ch, temb_ch)})
+
+        down = []
+        ch = bo[0]
+        for i, out_ch in enumerate(bo):
+            final = i == n - 1
+            resnets, attns = [], []
+            for j in range(lpb):
+                resnets.append(ResnetBlock2D(ch if j == 0 else out_ch, out_ch,
+                                             temb_ch, g))
+                if not final:
+                    attns.append(Transformer2DModel(out_ch, ctx, out_ch // hd,
+                                                    cfg.transformer_layers, g))
+            ch = out_ch
+            down.append(_Blockset(
+                resnets, attentions=attns if not final else None,
+                downsamplers=[Downsample2D(out_ch)] if not final else None))
+        self.down_blocks = nn.ModuleList(down)
+
+        mid_ch = bo[-1]
+        self.mid_block = _Blockset(
+            [ResnetBlock2D(mid_ch, mid_ch, temb_ch, g),
+             ResnetBlock2D(mid_ch, mid_ch, temb_ch, g)],
+            attentions=[Transformer2DModel(mid_ch, ctx, mid_ch // hd,
+                                           cfg.transformer_layers, g)])
+
+        # skip channel bookkeeping mirrors the down path
+        skip_chs = [bo[0]]
+        for i, out_ch in enumerate(bo):
+            skip_chs += [out_ch] * lpb
+            if i < n - 1:
+                skip_chs.append(out_ch)
+        up = []
+        ch = bo[-1]
+        for i, out_ch in enumerate(reversed(bo)):
+            first = i == 0                    # bottom of the U: no attention
+            resnets, attns = [], []
+            for j in range(lpb + 1):
+                skip = skip_chs.pop()
+                resnets.append(ResnetBlock2D(ch + skip, out_ch, temb_ch, g))
+                ch = out_ch
+                if not first:
+                    attns.append(Transformer2DModel(out_ch, ctx, out_ch // hd,
+                                                    cfg.transformer_layers, g))
+            up.append(_Blockset(
+                resnets, attentions=attns if not first else None,
+                upsamplers=[Upsample2D(out_ch)] if i < n - 1 else None))
+        self.up_blocks = nn.ModuleList(up)
+
+        self.conv_norm_out = nn.GroupNorm(g, bo[0], eps=1e-5)
+        self.conv_out = nn.Conv2d(bo[0], cfg.out_channels, 3, padding=1)
+
+    def forward(self, sample, timesteps, context):
+        temb = timestep_embedding(timesteps, self.cfg.block_out_channels[0])
+        temb = self.time_embedding["linear_2"](
+            F.silu(self.time_embedding["linear_1"](temb)))
+
+        h = self.conv_in(sample)
+        skips = [h]
+        for blk in self.down_blocks:
+            attns = list(getattr(blk, "attentions", []))
+            for j, res in enumerate(blk.resnets):
+                h = res(h, temb)
+                if attns:
+                    h = attns[j](h, context)
+                skips.append(h)
+            if hasattr(blk, "downsamplers"):
+                h = blk.downsamplers[0](h)
+                skips.append(h)
+
+        h = self.mid_block.resnets[0](h, temb)
+        h = self.mid_block.attentions[0](h, context)
+        h = self.mid_block.resnets[1](h, temb)
+
+        for blk in self.up_blocks:
+            attns = list(getattr(blk, "attentions", []))
+            for j, res in enumerate(blk.resnets):
+                h = res(torch.cat([h, skips.pop()], dim=1), temb)
+                if attns:
+                    h = attns[j](h, context)
+            if hasattr(blk, "upsamplers"):
+                h = blk.upsamplers[0](h)
+
+        return self.conv_out(F.silu(self.conv_norm_out(h)))
+
+
+class AttentionBlock(nn.Module):
+    """diffusers 0.14-era VAE attention (query/key/value/proj_attn naming)."""
+
+    def __init__(self, ch: int, groups: int):
+        super().__init__()
+        self.group_norm = nn.GroupNorm(groups, ch, eps=1e-6)
+        self.query = nn.Linear(ch, ch)
+        self.key = nn.Linear(ch, ch)
+        self.value = nn.Linear(ch, ch)
+        self.proj_attn = nn.Linear(ch, ch)
+
+    def forward(self, x):
+        b, c, h, w = x.shape
+        out = self.group_norm(x).permute(0, 2, 3, 1).reshape(b, h * w, c)
+        out = attention(self.query(out), self.key(out), self.value(out), 1)
+        out = self.proj_attn(out)
+        return out.reshape(b, h, w, c).permute(0, 3, 1, 2) + x
+
+
+class TorchAutoencoderKL(nn.Module):
+    """diffusers AutoencoderKL built from our ModelConfig (encode side returns
+    moments [mean, logvar]; decode maps latents to pixels)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        bo = cfg.vae_block_out_channels
+        lpb = cfg.vae_layers_per_block
+        g = min(cfg.norm_num_groups, bo[0])
+        zc = cfg.vae_latent_channels
+        n = len(bo)
+
+        enc = nn.Module()
+        enc.conv_in = nn.Conv2d(3, bo[0], 3, padding=1)
+        blocks = []
+        ch = bo[0]
+        for i, out_ch in enumerate(bo):
+            resnets = [ResnetBlock2D(ch if j == 0 else out_ch, out_ch,
+                                     0, g, eps=1e-6) for j in range(lpb)]
+            ch = out_ch
+            blocks.append(_Blockset(
+                resnets,
+                downsamplers=[Downsample2D(out_ch, asymmetric=True)]
+                if i < n - 1 else None))
+        enc.down_blocks = nn.ModuleList(blocks)
+        enc.mid_block = _Blockset(
+            [ResnetBlock2D(bo[-1], bo[-1], 0, g, eps=1e-6),
+             ResnetBlock2D(bo[-1], bo[-1], 0, g, eps=1e-6)],
+            attentions=[AttentionBlock(bo[-1], g)])
+        enc.conv_norm_out = nn.GroupNorm(g, bo[-1], eps=1e-6)
+        enc.conv_out = nn.Conv2d(bo[-1], 2 * zc, 3, padding=1)
+        self.encoder = enc
+        self.quant_conv = nn.Conv2d(2 * zc, 2 * zc, 1)
+
+        dec = nn.Module()
+        dec.conv_in = nn.Conv2d(zc, bo[-1], 3, padding=1)
+        dec.mid_block = _Blockset(
+            [ResnetBlock2D(bo[-1], bo[-1], 0, g, eps=1e-6),
+             ResnetBlock2D(bo[-1], bo[-1], 0, g, eps=1e-6)],
+            attentions=[AttentionBlock(bo[-1], g)])
+        blocks = []
+        ch = bo[-1]
+        for i, out_ch in enumerate(reversed(bo)):
+            resnets = [ResnetBlock2D(ch if j == 0 else out_ch, out_ch,
+                                     0, g, eps=1e-6) for j in range(lpb + 1)]
+            ch = out_ch
+            blocks.append(_Blockset(
+                resnets,
+                upsamplers=[Upsample2D(out_ch)] if i < n - 1 else None))
+        dec.up_blocks = nn.ModuleList(blocks)
+        dec.conv_norm_out = nn.GroupNorm(g, bo[0], eps=1e-6)
+        dec.conv_out = nn.Conv2d(bo[0], 3, 3, padding=1)
+        self.decoder = dec
+        self.post_quant_conv = nn.Conv2d(zc, zc, 1)
+
+    def encode(self, x):
+        h = self.encoder.conv_in(x)
+        for blk in self.encoder.down_blocks:
+            for res in blk.resnets:
+                h = res(h)
+            if hasattr(blk, "downsamplers"):
+                h = blk.downsamplers[0](h)
+        mb = self.encoder.mid_block
+        h = mb.resnets[1](mb.attentions[0](mb.resnets[0](h)))
+        h = self.encoder.conv_out(F.silu(self.encoder.conv_norm_out(h)))
+        return self.quant_conv(h)
+
+    def decode(self, z):
+        h = self.decoder.conv_in(self.post_quant_conv(z))
+        mb = self.decoder.mid_block
+        h = mb.resnets[1](mb.attentions[0](mb.resnets[0](h)))
+        for blk in self.decoder.up_blocks:
+            for res in blk.resnets:
+                h = res(h)
+            if hasattr(blk, "upsamplers"):
+                h = blk.upsamplers[0](h)
+        return self.decoder.conv_out(F.silu(self.decoder.conv_norm_out(h)))

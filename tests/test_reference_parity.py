@@ -75,6 +75,16 @@ def test_dino_vit_matches_reference_nonsquare_same_count(dino_ref, dino_params):
                                atol=7e-5, rtol=5e-4)
 
 
+def test_dino_vit_matches_reference_nondivisible_input(dino_ref, dino_params):
+    """36px input with patch 8: the reference's padding-0 patch conv floors
+    to a 4x4 grid (dino_vits.py:164-167); VALID padding must reproduce that
+    (SAME would emit a 5x5 grid and desync from the positional table)."""
+    data, _ = dino_ref
+    out = _model().apply(dino_params, _nhwc(data["x_ragged"]))
+    np.testing.assert_allclose(np.asarray(out), data["out_ragged"],
+                               atol=7e-5, rtol=5e-4)
+
+
 def test_dino_vit_matches_reference_intermediate_layers(dino_ref, dino_params):
     data, _ = dino_ref
     outs = _model().apply(dino_params, _nhwc(data["x_native"]),

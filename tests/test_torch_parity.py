@@ -1,0 +1,186 @@
+"""Cross-framework activation parity: Flax stack vs torch transcriptions.
+
+The flagship UNet/VAE (and the eval backbones in torch_backbones.py) are
+checked against independent torch implementations carrying the real
+diffusers/torchvision state-dict naming. Weights flow through the actual
+interop path — Flax params → models.export → torch `load_state_dict(strict=
+True)` → torch forward — so these tests cover, in one pass:
+
+- the exporter emits exactly the key set + layouts torch modules expect
+  (VERDICT r1 items 3/4);
+- NHWC Flax vs NCHW torch numerics: conv/GroupNorm/attention/GEGLU/
+  resample semantics (SURVEY.md §7.3 "weight-conversion fidelity");
+- the converters' inverse relationship (convert.py is exercised by loading
+  the exported dict back in test_export.py).
+
+Reference roles: diff_train.py:370-408 (UNet/VAE), metrics/ipr.py:41 (VGG),
+diff_retrieval.py:277-285 (SSCD).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from dcr_tpu.core.config import ModelConfig  # noqa: E402
+
+
+def tiny_cfg() -> ModelConfig:
+    return ModelConfig(
+        sample_size=8, block_out_channels=(32, 64), layers_per_block=1,
+        attention_head_dim=16, cross_attention_dim=48, transformer_layers=1,
+        norm_num_groups=8, flash_attention=False,
+        vae_block_out_channels=(32, 64), vae_layers_per_block=1,
+        vae_latent_channels=4)
+
+
+def to_torch(sd: dict) -> dict:
+    return {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in sd.items()}
+
+
+def test_unet_matches_torch_diffusers_twin():
+    from dcr_tpu.models.export import unet_to_diffusers
+    from dcr_tpu.models.unet2d import init_unet
+    from tests.fixtures.torch_diffusion import TorchUNet2DCondition
+
+    cfg = tiny_cfg()
+    model, params = init_unet(cfg, jax.random.key(0))
+    sd = unet_to_diffusers(params, n_blocks=len(cfg.block_out_channels))
+
+    twin = TorchUNet2DCondition(cfg)
+    missing, unexpected = twin.load_state_dict(to_torch(sd), strict=True)
+    assert not missing and not unexpected
+    twin.eval()
+
+    rng = np.random.default_rng(0)
+    sample = rng.standard_normal((2, 8, 8, cfg.in_channels)).astype(np.float32)
+    t = np.array([7, 421], np.int64)
+    ctx = rng.standard_normal((2, 5, cfg.cross_attention_dim)).astype(np.float32)
+
+    ours = model.apply({"params": params}, jnp.asarray(sample),
+                       jnp.asarray(t), jnp.asarray(ctx))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(sample).permute(0, 3, 1, 2),
+                      torch.from_numpy(t), torch.from_numpy(ctx))
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.permute(0, 2, 3, 1).numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_vae_matches_torch_diffusers_twin():
+    from dcr_tpu.models.export import vae_to_diffusers
+    from dcr_tpu.models.vae import AutoencoderKL, init_vae
+    from tests.fixtures.torch_diffusion import TorchAutoencoderKL
+
+    cfg = tiny_cfg()
+    model, params = init_vae(cfg, jax.random.key(1))
+    sd = vae_to_diffusers(params)
+
+    twin = TorchAutoencoderKL(cfg)
+    missing, unexpected = twin.load_state_dict(to_torch(sd), strict=True)
+    assert not missing and not unexpected
+    twin.eval()
+
+    rng = np.random.default_rng(1)
+    px = 2 ** (len(cfg.vae_block_out_channels) - 1) * cfg.sample_size
+    img = rng.standard_normal((2, px, px, 3)).astype(np.float32)
+
+    dist = model.apply({"params": params}, jnp.asarray(img),
+                       method=AutoencoderKL.encode)
+    moments = np.concatenate([np.asarray(dist.mean), np.asarray(dist.logvar)],
+                             axis=-1)
+    with torch.no_grad():
+        t_moments = twin.encode(torch.from_numpy(img).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(moments,
+                               t_moments.permute(0, 2, 3, 1).numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+    z = rng.standard_normal((2, cfg.sample_size, cfg.sample_size,
+                             cfg.vae_latent_channels)).astype(np.float32)
+    dec = model.apply({"params": params}, jnp.asarray(z),
+                      method=AutoencoderKL.decode)
+    with torch.no_grad():
+        t_dec = twin.decode(torch.from_numpy(z).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(dec),
+                               t_dec.permute(0, 2, 3, 1).numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def _randomize(module: torch.nn.Module, seed: int) -> None:
+    """Random weights AND random BatchNorm running stats (the defaults —
+    zero mean, unit var — would mask conversion bugs in the stats)."""
+    g = torch.Generator().manual_seed(seed)
+    with torch.no_grad():
+        for p in module.parameters():
+            p.copy_(torch.randn(p.shape, generator=g) * 0.05)
+        for name, b in module.named_buffers():
+            if name.endswith("running_mean"):
+                b.copy_(torch.randn(b.shape, generator=g) * 0.1)
+            elif name.endswith("running_var"):
+                b.copy_(torch.rand(b.shape, generator=g) + 0.5)
+
+
+def test_sscd_matches_torch_twin():
+    from dcr_tpu.models.convert import convert_sscd
+    from dcr_tpu.models.resnet import SSCDModel
+    from tests.fixtures.torch_backbones import TorchSSCD
+
+    twin = TorchSSCD(embed_dim=64)
+    _randomize(twin, 2)
+    twin.eval()
+    sd = {k: v.numpy() for k, v in twin.state_dict().items()}
+    params = convert_sscd(sd)
+
+    rng = np.random.default_rng(2)
+    img = rng.standard_normal((2, 64, 64, 3)).astype(np.float32)
+    ours = SSCDModel(embed_dim=64).apply({"params": params}, jnp.asarray(img))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_inception_fid_matches_torch_twin():
+    from dcr_tpu.models.convert import convert_inception_fid
+    from dcr_tpu.models.inception import InceptionV3FID
+    from tests.fixtures.torch_backbones import TorchInceptionFID
+
+    twin = TorchInceptionFID()
+    _randomize(twin, 4)
+    twin.eval()
+    sd = {k: v.numpy() for k, v in twin.state_dict().items()}
+    params = convert_inception_fid(sd)
+
+    rng = np.random.default_rng(4)
+    img = rng.uniform(0.0, 1.0, (2, 128, 128, 3)).astype(np.float32)
+    # the 128->299 path also checks our bilinear resize against torch's
+    ours = InceptionV3FID().apply({"params": params}, jnp.asarray(img))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_vgg16_matches_torch_twin():
+    from dcr_tpu.models.convert import convert_vgg16
+    from dcr_tpu.models.vgg import VGG16Features
+    from tests.fixtures.torch_backbones import TorchVGG16
+
+    twin = TorchVGG16()
+    _randomize(twin, 3)
+    twin.eval()
+    sd = {k: v.numpy() for k, v in twin.state_dict().items()}
+    params = convert_vgg16(sd)
+
+    rng = np.random.default_rng(3)
+    img = rng.uniform(0.0, 1.0, (2, 224, 224, 3)).astype(np.float32)
+    ours = VGG16Features().apply({"params": params}, jnp.asarray(img))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+    # unnormalized random-weight activations reach ~5e3; 0.05 abs ≈ 1e-5 rel
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               atol=0.05, rtol=1e-3)

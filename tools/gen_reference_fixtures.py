@@ -64,18 +64,21 @@ def gen_dino() -> None:
     # non-square with the SAME patch count as the table (2x8 = 16): the
     # reference still interpolates because w != h (dino_vits.py:216)
     x_rect = torch.randn(2, 3, 16, 64, generator=g)
+    # non-divisible input: the reference's padding-0 patch conv floors 36->4
+    x_ragged = torch.randn(2, 3, 36, 36, generator=g)
     with torch.no_grad():
         out_native = model(x_native)
         out_interp = model(x_interp)
         out_rect = model(x_rect)
+        out_ragged = model(x_ragged)
         inter = model.get_intermediate_layers(x_native, n=2)
 
     arrays = {f"sd/{k}": v.numpy() for k, v in model.state_dict().items()}
     arrays.update(
         x_native=x_native.numpy(), x_interp=x_interp.numpy(),
-        x_rect=x_rect.numpy(),
+        x_rect=x_rect.numpy(), x_ragged=x_ragged.numpy(),
         out_native=out_native.numpy(), out_interp=out_interp.numpy(),
-        out_rect=out_rect.numpy(),
+        out_rect=out_rect.numpy(), out_ragged=out_ragged.numpy(),
         inter_0=inter[0].numpy(), inter_1=inter[1].numpy())
     out = GOLD / "dino_reference.npz"
     np.savez_compressed(out, **arrays)
