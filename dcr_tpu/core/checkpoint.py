@@ -78,7 +78,12 @@ def _diffusers_configs(mc: dict) -> dict[str, dict]:
     our ModelConfig dict (mirrors stabilityai/stable-diffusion-2-1's shipped
     configs at the default dims)."""
     ch = list(mc.get("block_out_channels", (320, 640, 1280, 1280)))
+    # diffusers' (misnamed) attention_head_dim is the per-block HEAD COUNT:
+    # SD-2.x configs list C // 64 per block; SD-1.x configs carry the scalar
+    # fixed count (8) with conv projections (use_linear_projection false)
+    num_heads = mc.get("attention_num_heads")
     head_dim = mc.get("attention_head_dim", 64)
+    heads_cfg = num_heads if num_heads else [c // head_dim for c in ch]
     n = len(ch)
     unet = {
         "_class_name": "UNet2DConditionModel",
@@ -91,9 +96,8 @@ def _diffusers_configs(mc: dict) -> dict[str, dict]:
         "block_out_channels": ch,
         "layers_per_block": mc.get("layers_per_block", 2),
         "cross_attention_dim": mc.get("cross_attention_dim", 1024),
-        # diffusers' (misnamed) per-block heads list: C // head_dim
-        "attention_head_dim": [c // head_dim for c in ch],
-        "use_linear_projection": True,
+        "attention_head_dim": heads_cfg,
+        "use_linear_projection": bool(mc.get("use_linear_projection", True)),
         "norm_num_groups": mc.get("norm_num_groups", 32),
         "act_fn": "silu",
         "center_input_sample": False,

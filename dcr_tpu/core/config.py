@@ -80,8 +80,15 @@ class ModelConfig:
     block_out_channels: tuple[int, ...] = (320, 640, 1280, 1280)
     layers_per_block: int = 2
     attention_head_dim: int = 64
+    # SD-1.x fixes the head COUNT instead (8 heads, head_dim = ch/8); when
+    # set, attention_head_dim is ignored. Needed to express the
+    # CompVis/stable-diffusion-v1-4 UNet the reference's mitigation driver
+    # is hardcoded to (sd_mitigation.py:46).
+    attention_num_heads: Optional[int] = None  # Optional[...] so CLI coercion works
     cross_attention_dim: int = 1024
     transformer_layers: int = 1
+    # SD-2.x transformers project with linears; SD-1.x uses 1x1 convs
+    use_linear_projection: bool = True
     norm_num_groups: int = 32
     flash_attention: bool = True       # Pallas kernel when on TPU, XLA fallback otherwise
     # Spatial self-attention switches to ring attention (K/V rotating over the
@@ -111,6 +118,24 @@ class ModelConfig:
     beta_start: float = 0.00085
     beta_end: float = 0.012
     prediction_type: str = "epsilon"   # or "v_prediction"
+
+    @staticmethod
+    def sd1x() -> "ModelConfig":
+        """SD-1.4/1.5 stack: fixed 8-head attention, 1x1-conv transformer
+        projections, CLIP ViT-L/14 text tower (quick_gelu, 768-d). The
+        reference's mitigation driver targets this model family
+        (sd_mitigation.py:46: CompVis/stable-diffusion-v1-4)."""
+        return ModelConfig(
+            sample_size=64,
+            attention_head_dim=0,
+            attention_num_heads=8,
+            use_linear_projection=False,
+            cross_attention_dim=768,
+            text_hidden_size=768,
+            text_layers=12,
+            text_heads=12,
+            text_act="quick_gelu",
+        )
 
     @staticmethod
     def tiny() -> "ModelConfig":

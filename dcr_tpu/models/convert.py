@@ -347,8 +347,12 @@ def _resnet_block(t: dict, dst: str, sd: StateDict, src: str) -> None:
 def _transformer2d(t: dict, dst: str, sd: StateDict, src: str,
                    num_layers: int) -> None:
     _groupnorm(t, f"{dst}/norm", sd, f"{src}.norm")
-    _linear(t, f"{dst}/proj_in", sd, f"{src}.proj_in")
-    _linear(t, f"{dst}/proj_out", sd, f"{src}.proj_out")
+    for proj in ("proj_in", "proj_out"):
+        # SD-2.x projects with linears, SD-1.x with 1x1 convs (4-D weight)
+        if sd[f"{src}.{proj}.weight"].ndim == 4:
+            _conv(t, f"{dst}/{proj}", sd, f"{src}.{proj}")
+        else:
+            _linear(t, f"{dst}/{proj}", sd, f"{src}.{proj}")
     for k in range(num_layers):
         bsrc = f"{src}.transformer_blocks.{k}"
         bdst = f"{dst}/blocks_{k}"

@@ -192,13 +192,19 @@ class BasicTransformerBlock(nn.Module):
 
 
 class Transformer2D(nn.Module):
-    """Spatial transformer: GN → linear in → N blocks → linear out + residual."""
+    """Spatial transformer: GN → proj in → N blocks → proj out + residual.
+
+    use_linear_projection selects the SD-2.x linear projections (default) or
+    the SD-1.x 1x1 convs — same math, different weight shape and apply order
+    (conv before the [B,HW,C] reshape), matching diffusers so checkpoints of
+    both families convert losslessly."""
 
     num_heads: int
     head_dim: int
     num_layers: int = 1
     num_groups: int = 32
     use_flash: bool = True
+    use_linear_projection: bool = True
     dtype: jnp.dtype = jnp.float32
     mesh: Optional[jax.sharding.Mesh] = None
     seq_parallel_min_seq: int = 4096
@@ -211,16 +217,25 @@ class Transformer2D(nn.Module):
         # diffusers Transformer2DModel norms with eps=1e-6 (unlike the 1e-5
         # resnet norms); mismatch silently drifts converted SD weights
         out = GroupNorm(self.num_groups, epsilon=1e-6, name="norm")(x)
-        out = out.reshape(b, h * w, c)
-        out = nn.Dense(inner, dtype=self.dtype, name="proj_in")(out)
+        if self.use_linear_projection:
+            out = out.reshape(b, h * w, c)
+            out = nn.Dense(inner, dtype=self.dtype, name="proj_in")(out)
+        else:
+            out = nn.Conv(inner, (1, 1), dtype=self.dtype, name="proj_in")(out)
+            out = out.reshape(b, h * w, inner)
         for i in range(self.num_layers):
             out = BasicTransformerBlock(inner, self.num_heads, self.head_dim,
                                         use_flash=self.use_flash, dtype=self.dtype,
                                         mesh=self.mesh,
                                         seq_parallel_min_seq=self.seq_parallel_min_seq,
                                         name=f"blocks_{i}")(out, context)
-        out = nn.Dense(c, dtype=self.dtype, name="proj_out")(out)
-        return out.reshape(b, h, w, c) + residual
+        if self.use_linear_projection:
+            out = nn.Dense(c, dtype=self.dtype, name="proj_out")(out)
+            out = out.reshape(b, h, w, c)
+        else:
+            out = nn.Conv(c, (1, 1), dtype=self.dtype,
+                          name="proj_out")(out.reshape(b, h, w, inner))
+        return out + residual
 
 
 class Downsample2D(nn.Module):

@@ -22,6 +22,14 @@ from dcr_tpu.core.config import ModelConfig
 from dcr_tpu.models import layers as L
 
 
+def attn_dims(cfg: ModelConfig, ch: int) -> tuple[int, int]:
+    """(num_heads, head_dim) for a block of width ch. SD-2.x fixes head_dim
+    (64) and varies the count; SD-1.x fixes the count (8) and varies the dim."""
+    if cfg.attention_num_heads:
+        return cfg.attention_num_heads, ch // cfg.attention_num_heads
+    return ch // cfg.attention_head_dim, cfg.attention_head_dim
+
+
 class UNet2DCondition(nn.Module):
     config: ModelConfig
     dtype: jnp.dtype = jnp.float32
@@ -38,8 +46,16 @@ class UNet2DCondition(nn.Module):
         dtype = self.dtype
         block_out = cfg.block_out_channels
         n_blocks = len(block_out)
-        head_dim = cfg.attention_head_dim
         groups = cfg.norm_num_groups
+
+        def transformer(ch: int, name: str) -> L.Transformer2D:
+            heads, head_dim = attn_dims(cfg, ch)
+            return L.Transformer2D(
+                heads, head_dim, num_layers=cfg.transformer_layers,
+                num_groups=groups, use_flash=cfg.flash_attention,
+                use_linear_projection=cfg.use_linear_projection, dtype=dtype,
+                mesh=self.mesh,
+                seq_parallel_min_seq=cfg.seq_parallel_min_seq, name=name)
 
         # --- time embedding
         t_emb = L.timestep_embedding(timesteps, block_out[0])
@@ -59,13 +75,7 @@ class UNet2DCondition(nn.Module):
                 h = L.ResnetBlock2D(ch, num_groups=groups, dtype=dtype,
                                     name=f"down_{i}_res_{j}")(h, temb, deterministic)
                 if not is_final:  # cross-attn blocks everywhere but the bottom
-                    h = L.Transformer2D(ch // head_dim, head_dim,
-                                        num_layers=cfg.transformer_layers,
-                                        num_groups=groups,
-                                        use_flash=cfg.flash_attention, dtype=dtype,
-                                        mesh=self.mesh,
-                                        seq_parallel_min_seq=cfg.seq_parallel_min_seq,
-                                        name=f"down_{i}_attn_{j}")(h, context)
+                    h = transformer(ch, f"down_{i}_attn_{j}")(h, context)
                 skips.append(h)
             if not is_final:
                 h = L.Downsample2D(ch, dtype=dtype, name=f"down_{i}_downsample")(h)
@@ -75,12 +85,7 @@ class UNet2DCondition(nn.Module):
         mid_ch = block_out[-1]
         h = L.ResnetBlock2D(mid_ch, num_groups=groups, dtype=dtype,
                             name="mid_res_0")(h, temb, deterministic)
-        h = L.Transformer2D(mid_ch // head_dim, head_dim,
-                            num_layers=cfg.transformer_layers, num_groups=groups,
-                            use_flash=cfg.flash_attention, dtype=dtype,
-                            mesh=self.mesh,
-                            seq_parallel_min_seq=cfg.seq_parallel_min_seq,
-                            name="mid_attn")(h, context)
+        h = transformer(mid_ch, "mid_attn")(h, context)
         h = L.ResnetBlock2D(mid_ch, num_groups=groups, dtype=dtype,
                             name="mid_res_1")(h, temb, deterministic)
 
@@ -94,13 +99,7 @@ class UNet2DCondition(nn.Module):
                 h = L.ResnetBlock2D(ch, num_groups=groups, dtype=dtype,
                                     name=f"up_{block_idx}_res_{j}")(h, temb, deterministic)
                 if not is_first:
-                    h = L.Transformer2D(ch // head_dim, head_dim,
-                                        num_layers=cfg.transformer_layers,
-                                        num_groups=groups,
-                                        use_flash=cfg.flash_attention, dtype=dtype,
-                                        mesh=self.mesh,
-                                        seq_parallel_min_seq=cfg.seq_parallel_min_seq,
-                                        name=f"up_{block_idx}_attn_{j}")(h, context)
+                    h = transformer(ch, f"up_{block_idx}_attn_{j}")(h, context)
             if block_idx > 0:
                 h = L.Upsample2D(ch, dtype=dtype, name=f"up_{block_idx}_upsample")(h)
 

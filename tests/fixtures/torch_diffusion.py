@@ -110,26 +110,39 @@ class BasicTransformerBlock(nn.Module):
 
 
 class Transformer2DModel(nn.Module):
-    """SD-2.x linear-projection spatial transformer."""
+    """Spatial transformer. use_linear: SD-2.x linear projections applied
+    after the reshape; else SD-1.x 1x1 convs applied before it."""
 
     def __init__(self, ch: int, ctx_dim: int, heads: int, layers: int,
-                 groups: int = 32):
+                 groups: int = 32, use_linear: bool = True):
         super().__init__()
+        self.use_linear = use_linear
         self.norm = nn.GroupNorm(groups, ch, eps=1e-6)
-        self.proj_in = nn.Linear(ch, ch)
+        proj = (lambda: nn.Linear(ch, ch)) if use_linear else \
+               (lambda: nn.Conv2d(ch, ch, 1))
+        self.proj_in = proj()
         self.transformer_blocks = nn.ModuleList(
             [BasicTransformerBlock(ch, ctx_dim, heads) for _ in range(layers)])
-        self.proj_out = nn.Linear(ch, ch)
+        self.proj_out = proj()
 
     def forward(self, x, ctx):
         b, c, h, w = x.shape
         res = x
-        out = self.norm(x).permute(0, 2, 3, 1).reshape(b, h * w, c)
-        out = self.proj_in(out)
+        out = self.norm(x)
+        if self.use_linear:
+            out = out.permute(0, 2, 3, 1).reshape(b, h * w, c)
+            out = self.proj_in(out)
+        else:
+            out = self.proj_in(out).permute(0, 2, 3, 1).reshape(b, h * w, c)
         for blk in self.transformer_blocks:
             out = blk(out, ctx)
-        out = self.proj_out(out)
-        return out.reshape(b, h, w, c).permute(0, 3, 1, 2) + res
+        if self.use_linear:
+            out = self.proj_out(out)
+            out = out.reshape(b, h, w, c).permute(0, 3, 1, 2)
+        else:
+            out = out.reshape(b, h, w, c).permute(0, 3, 1, 2)
+            out = self.proj_out(out)
+        return out + res
 
 
 class Downsample2D(nn.Module):
@@ -177,11 +190,17 @@ class TorchUNet2DCondition(nn.Module):
         bo = cfg.block_out_channels
         n = len(bo)
         temb_ch = bo[0] * 4
-        hd = cfg.attention_head_dim
         ctx = cfg.cross_attention_dim
         lpb = cfg.layers_per_block
         g = cfg.norm_num_groups
         self.cfg = cfg
+
+        def t2d(ch: int) -> Transformer2DModel:
+            heads = (cfg.attention_num_heads
+                     or ch // cfg.attention_head_dim)
+            return Transformer2DModel(
+                ch, ctx, heads, cfg.transformer_layers, g,
+                use_linear=cfg.use_linear_projection)
 
         self.conv_in = nn.Conv2d(cfg.in_channels, bo[0], 3, padding=1)
         self.time_embedding = nn.ModuleDict({
@@ -197,8 +216,7 @@ class TorchUNet2DCondition(nn.Module):
                 resnets.append(ResnetBlock2D(ch if j == 0 else out_ch, out_ch,
                                              temb_ch, g))
                 if not final:
-                    attns.append(Transformer2DModel(out_ch, ctx, out_ch // hd,
-                                                    cfg.transformer_layers, g))
+                    attns.append(t2d(out_ch))
             ch = out_ch
             down.append(_Blockset(
                 resnets, attentions=attns if not final else None,
@@ -209,8 +227,7 @@ class TorchUNet2DCondition(nn.Module):
         self.mid_block = _Blockset(
             [ResnetBlock2D(mid_ch, mid_ch, temb_ch, g),
              ResnetBlock2D(mid_ch, mid_ch, temb_ch, g)],
-            attentions=[Transformer2DModel(mid_ch, ctx, mid_ch // hd,
-                                           cfg.transformer_layers, g)])
+            attentions=[t2d(mid_ch)])
 
         # skip channel bookkeeping mirrors the down path
         skip_chs = [bo[0]]
@@ -228,8 +245,7 @@ class TorchUNet2DCondition(nn.Module):
                 resnets.append(ResnetBlock2D(ch + skip, out_ch, temb_ch, g))
                 ch = out_ch
                 if not first:
-                    attns.append(Transformer2DModel(out_ch, ctx, out_ch // hd,
-                                                    cfg.transformer_layers, g))
+                    attns.append(t2d(out_ch))
             up.append(_Blockset(
                 resnets, attentions=attns if not first else None,
                 upsamplers=[Upsample2D(out_ch)] if i < n - 1 else None))

@@ -293,3 +293,34 @@ def test_openai_clip_structural_roundtrip():
         jax.random.key(1), np.zeros((1, 8), np.int32))["params"]
     assert CV.check_converted(text_init, params["text"]) == []
     assert params["text_projection"].shape == (tw, embed)
+
+
+def test_sd1x_hf_layout_export(tmp_path):
+    """SD-1.x family: export emits the scalar fixed head count +
+    use_linear_projection=false diffusers config (the crash/mis-description
+    regression), and the conv-projection safetensors round-trip."""
+    import dataclasses
+
+    from dcr_tpu.core.checkpoint import export_hf_layout
+    from dcr_tpu.core.config import to_dict
+    from dcr_tpu.models.unet2d import init_unet
+    from safetensors.numpy import load_file
+
+    cfg = dataclasses.replace(
+        ModelConfig.sd1x(), sample_size=8, block_out_channels=(32, 64),
+        layers_per_block=1, attention_num_heads=2, norm_num_groups=8,
+        cross_attention_dim=48, flash_attention=False,
+        vae_block_out_channels=(16, 32), vae_layers_per_block=1)
+    _, up = init_unet(cfg, jax.random.key(0))
+    export_hf_layout(tmp_path / "ckpt", unet=up, model_config=to_dict(cfg))
+
+    ucfg = json.loads((tmp_path / "ckpt" / "unet" / "config.json").read_text())
+    assert ucfg["attention_head_dim"] == 2          # scalar fixed head count
+    assert ucfg["use_linear_projection"] is False
+    sd = load_file(str(tmp_path / "ckpt" / "unet" /
+                       "diffusion_pytorch_model.safetensors"))
+    assert sd["mid_block.attentions.0.proj_in.weight"].ndim == 4
+    back = CV.convert_unet(sd, block_out_channels=cfg.block_out_channels,
+                           layers_per_block=cfg.layers_per_block,
+                           transformer_layers=cfg.transformer_layers)
+    assert CV.check_converted(up, back) == []

@@ -110,6 +110,50 @@ def test_vae_matches_torch_diffusers_twin():
                                atol=2e-4, rtol=1e-3)
 
 
+def test_sd1x_unet_matches_torch_twin_and_roundtrips():
+    """SD-1.x variant (sd_mitigation.py:46's model family): fixed 8-head
+    attention + 1x1-conv transformer projections. Parity through export AND
+    back through convert_unet (conv-shaped proj weights)."""
+    from dcr_tpu.models.convert import convert_unet
+    from dcr_tpu.models.export import unet_to_diffusers
+    from dcr_tpu.models.unet2d import init_unet
+    from tests.fixtures.torch_diffusion import TorchUNet2DCondition
+
+    cfg = tiny_cfg()
+    cfg.attention_num_heads = 2
+    cfg.use_linear_projection = False
+    model, params = init_unet(cfg, jax.random.key(5))
+    sd = unet_to_diffusers(params, n_blocks=len(cfg.block_out_channels))
+
+    twin = TorchUNet2DCondition(cfg)
+    missing, unexpected = twin.load_state_dict(to_torch(sd), strict=True)
+    assert not missing and not unexpected
+    twin.eval()
+
+    rng = np.random.default_rng(5)
+    sample = rng.standard_normal((2, 8, 8, cfg.in_channels)).astype(np.float32)
+    t = np.array([0, 999], np.int64)
+    ctx = rng.standard_normal((2, 5, cfg.cross_attention_dim)).astype(np.float32)
+
+    ours = model.apply({"params": params}, jnp.asarray(sample),
+                       jnp.asarray(t), jnp.asarray(ctx))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(sample).permute(0, 3, 1, 2),
+                      torch.from_numpy(t), torch.from_numpy(ctx))
+    np.testing.assert_allclose(np.asarray(ours),
+                               theirs.permute(0, 2, 3, 1).numpy(),
+                               atol=5e-4, rtol=1e-3)
+
+    # checkpoint-source direction: the exported dict converts back losslessly
+    back = convert_unet(sd, block_out_channels=cfg.block_out_channels,
+                        layers_per_block=cfg.layers_per_block,
+                        transformer_layers=cfg.transformer_layers)
+    again = model.apply({"params": back}, jnp.asarray(sample),
+                        jnp.asarray(t), jnp.asarray(ctx))
+    np.testing.assert_allclose(np.asarray(again), np.asarray(ours),
+                               atol=1e-6, rtol=1e-6)
+
+
 def _randomize(module: torch.nn.Module, seed: int) -> None:
     """Random weights AND random BatchNorm running stats (the defaults —
     zero mean, unit var — would mask conversion bugs in the stats)."""
