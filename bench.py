@@ -94,7 +94,8 @@ def probe(jax) -> float:
     return time.perf_counter() - t0
 
 
-def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10) -> dict:
+def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
+               remat: bool = False) -> dict:
     import numpy as np
 
     from dcr_tpu.core.config import MeshConfig, ModelConfig, TrainConfig
@@ -104,7 +105,8 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10) -> dict:
     from dcr_tpu.parallel import mesh as pmesh
     from dcr_tpu.utils import profiling
 
-    cfg = TrainConfig(mixed_precision="bf16", train_batch_size=batch_size)
+    cfg = TrainConfig(mixed_precision="bf16", train_batch_size=batch_size,
+                      remat=remat)
     cfg.model = ModelConfig()           # full SD-2.1 dims, 256px (32x32 latents)
     cfg.optim.lr_warmup_steps = 0
     cfg.mesh = MeshConfig()
@@ -180,6 +182,7 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10) -> dict:
     result = {"bs": batch_size, "images_per_sec_per_chip": round(imgs, 3),
               "step_ms": round(dt * 1e3, 1),
               "mfu": round(mfu, 4) if mfu else None,
+              "remat": remat,
               "loss": round(float(m["loss"]), 4)}
     mark("rung_done", **result)
     return result
@@ -231,6 +234,18 @@ def main() -> None:
             queue.clear()
             if bs > 1:
                 queue.append(bs // 2)
+    # bonus rung: bs=32 only fits with rematerialization (plain bs=32 fails
+    # remote-compile); try it when the whole ladder succeeded and budget
+    # remains — strictly additive, failure here never loses the banked best
+    if (best is not None and err is None and not os.environ.get("BENCH_BS")
+            and time.monotonic() - t_start < budget):
+        dog.rearm()
+        try:
+            result = bench_rung(jax, 32, dog, remat=True)
+            if result["images_per_sec_per_chip"] > best["images_per_sec_per_chip"]:
+                best = result
+        except Exception as e:
+            mark("rung_failed", bs=32, remat=True, error=repr(e)[:500])
     if best is None:
         mark("failed", error=repr(err)[:500])
         raise SystemExit(f"bench failed at all batch sizes: {err}")

@@ -175,7 +175,10 @@ def make_extractor(apply_fn: Callable, params, mesh, *, multiscale: bool = False
                 inp = images
             else:
                 nh, nw = int(h * s), int(w * s)
-                inp = jax.image.resize(images, (b, nh, nw, c), method="bilinear")
+                # antialias=False: torch's F.interpolate (the reference's
+                # downsample here) never low-pass filters
+                inp = jax.image.resize(images, (b, nh, nw, c),
+                                       method="bilinear", antialias=False)
             feats = apply_fn(params, inp)
             acc = feats if acc is None else acc + feats
         acc = acc / 3.0

@@ -146,7 +146,12 @@ class InceptionV3FID(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         if self.resize_input and x.shape[1:3] != (299, 299):
-            x = jax.image.resize(x, (x.shape[0], 299, 299, 3), method="bilinear")
+            # antialias=False matches the reference's F.interpolate bilinear
+            # (metrics/inception.py:149-151), which never low-pass filters —
+            # with the default antialias=True, FID on >299px inputs would
+            # silently diverge from reference numbers
+            x = jax.image.resize(x, (x.shape[0], 299, 299, 3),
+                                 method="bilinear", antialias=False)
         if self.normalize_input:
             x = x * 2.0 - 1.0
         x = ConvBN(32, (3, 3), strides=(2, 2), dtype=self.dtype, name="Conv2d_1a_3x3")(x)

@@ -8,6 +8,9 @@ from dcr_tpu.models.inception import InceptionV3FID
 from dcr_tpu.models.resnet import SSCDModel, gem_pool
 from dcr_tpu.models.vit import vit_tiny
 
+# large backbone compiles: excluded from the quick suite (`pytest -m 'not slow'`)
+pytestmark = pytest.mark.slow
+
 
 def test_sscd_shapes():
     model = SSCDModel(embed_dim=512)

@@ -13,6 +13,9 @@ from dcr_tpu.data.tokenizer import HashTokenizer
 from dcr_tpu.eval.features import EvalImageFolder
 from dcr_tpu.eval.runner import run_eval
 
+# full eval pipeline: excluded from the quick suite (`pytest -m 'not slow'`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def eval_dirs(tmp_path_factory):

@@ -15,6 +15,9 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# golden forwards incl. big models: excluded from the quick suite (`pytest -m 'not slow'`)
+pytestmark = pytest.mark.slow
+
 GOLDEN_DIR = Path(__file__).parent / "goldens"
 
 

@@ -11,6 +11,9 @@ from dcr_tpu.data.tokenizer import HashTokenizer
 from dcr_tpu.diffusion.trainer import build_models
 from dcr_tpu.sampling.pipeline import generate, load_checkpoint_models, resolve_checkpoint
 
+# checkpoint->PNG sampling: excluded from the quick suite (`pytest -m 'not slow'`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def exported_ckpt(tmp_path_factory):

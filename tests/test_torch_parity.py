@@ -200,13 +200,16 @@ def test_inception_fid_matches_torch_twin():
     params = convert_inception_fid(sd)
 
     rng = np.random.default_rng(4)
-    img = rng.uniform(0.0, 1.0, (2, 128, 128, 3)).astype(np.float32)
-    # the 128->299 path also checks our bilinear resize against torch's
-    ours = InceptionV3FID().apply({"params": params}, jnp.asarray(img))
-    with torch.no_grad():
-        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
-    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
-                               atol=2e-4, rtol=1e-3)
+    model = InceptionV3FID()
+    # 128->299 upsample AND 320->299 downsample: torch's F.interpolate never
+    # antialiases, so ours must not either (FID would silently diverge)
+    for size in (128, 320):
+        img = rng.uniform(0.0, 1.0, (2, size, size, 3)).astype(np.float32)
+        ours = model.apply({"params": params}, jnp.asarray(img))
+        with torch.no_grad():
+            theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+        np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"size={size}")
 
 
 def test_vgg16_matches_torch_twin():

@@ -10,6 +10,9 @@ from PIL import Image
 from dcr_tpu.core.config import DataConfig, ModelConfig, OptimConfig, TrainConfig
 from dcr_tpu.diffusion.trainer import Trainer
 
+# end-to-end train loops: excluded from the quick suite (`pytest -m 'not slow'`)
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture()
 def train_setup(tmp_path):
