@@ -217,11 +217,139 @@ def export_hf_layout(out_dir: str | Path, *, unet=None, vae=None, text_encoder=N
         (out / "model_index.json").write_text(json.dumps(index, indent=2))
 
 
+_TORCH_WEIGHT_NAMES = ("diffusion_pytorch_model.safetensors", "model.safetensors",
+                       "diffusion_pytorch_model.fp16.safetensors",
+                       "model.fp16.safetensors",
+                       "diffusion_pytorch_model.bin", "pytorch_model.bin",
+                       "diffusion_pytorch_model.fp16.bin", "pytorch_model.fp16.bin")
+
+
+def _load_torch_sd(path: Path) -> dict[str, np.ndarray]:
+    if path.suffix == ".safetensors":
+        from safetensors.numpy import load_file
+
+        return load_file(str(path))
+    import torch
+
+    from dcr_tpu.models.convert import torch_state_dict_to_numpy
+
+    return torch_state_dict_to_numpy(
+        torch.load(str(path), map_location="cpu", weights_only=True))
+
+
 def import_hf_layout(ckpt_dir: str | Path, component: str) -> dict:
-    sub = Path(ckpt_dir) / component / "params.npz"
-    with np.load(sub) as z:
-        flat = {k: z[k] for k in z.files}
-    return _unflatten(flat)
+    """Load one component's Flax params from an HF-layout checkpoint dir.
+
+    Fast path: params.npz (our own exports). Fallback: a GENUINE
+    diffusers/transformers checkpoint — torch-layout weights
+    (safetensors/bin) + the subfolder's config.json, routed through
+    models/convert.py. This makes a downloaded SD checkpoint directory
+    (the reference's input format, diff_train.py:370-408) loadable with no
+    manual conversion step."""
+    sub_dir = Path(ckpt_dir) / component
+    npz = sub_dir / "params.npz"
+    if npz.exists():
+        with np.load(npz) as z:
+            flat = {k: z[k] for k in z.files}
+        return _unflatten(flat)
+
+    weight_file = next((sub_dir / n for n in _TORCH_WEIGHT_NAMES
+                        if (sub_dir / n).exists()), None)
+    if weight_file is None:
+        raise FileNotFoundError(
+            f"no params.npz or torch weights ({'/'.join(_TORCH_WEIGHT_NAMES)}) "
+            f"under {sub_dir}")
+    from dcr_tpu.models import convert as CV
+
+    sd = _load_torch_sd(weight_file)
+    cfg = json.loads((sub_dir / "config.json").read_text())
+    if component == "unet":
+        return CV.convert_unet(
+            sd, block_out_channels=tuple(cfg["block_out_channels"]),
+            layers_per_block=cfg.get("layers_per_block", 2),
+            transformer_layers=_uniform_transformer_layers(cfg))
+    if component == "vae":
+        return CV.convert_vae(
+            sd, block_out_channels=tuple(cfg["block_out_channels"]),
+            layers_per_block=cfg.get("layers_per_block", 2))
+    if component == "text_encoder":
+        return CV.convert_clip_text(sd, layers=cfg["num_hidden_layers"],
+                                    heads=cfg["num_attention_heads"])
+    raise ValueError(f"unknown component {component!r}")
+
+
+def _uniform_transformer_layers(unet_cfg: dict) -> int:
+    """SD-1.x/2.x UNets use one transformer depth everywhere; SDXL-style
+    per-block lists ([1, 2, 10]) are a different architecture — refuse loudly
+    rather than silently building the wrong model from a weight subset."""
+    tl = unet_cfg.get("transformer_layers_per_block", 1)
+    if isinstance(tl, (list, tuple)):
+        if len(set(tl)) != 1:
+            raise ValueError(
+                f"per-block transformer depths {tl} (SDXL-family?) are not "
+                "supported by this UNet architecture")
+        tl = tl[0]
+    return int(tl)
+
+
+def model_config_from_diffusers(ckpt_dir: str | Path) -> dict:
+    """Infer our ModelConfig fields from a genuine diffusers checkpoint's
+    per-subfolder config.json files (inverse of _diffusers_configs). Handles
+    both head conventions: SD-2.x per-block head lists with a common head_dim,
+    SD-1.x scalar fixed head count."""
+    ckpt = Path(ckpt_dir)
+    u = json.loads((ckpt / "unet" / "config.json").read_text())
+    block_out = list(u["block_out_channels"])
+    heads = u.get("attention_head_dim", 8)
+    out: dict = {
+        "sample_size": u.get("sample_size", 32),
+        "in_channels": u.get("in_channels", 4),
+        "out_channels": u.get("out_channels", 4),
+        "block_out_channels": tuple(block_out),
+        "layers_per_block": u.get("layers_per_block", 2),
+        "cross_attention_dim": u.get("cross_attention_dim", 1024),
+        "use_linear_projection": u.get("use_linear_projection", False),
+        "norm_num_groups": u.get("norm_num_groups", 32),
+    }
+    out["transformer_layers"] = _uniform_transformer_layers(u)
+    if isinstance(heads, (list, tuple)):
+        head_dims = {c // h for c, h in zip(block_out, heads)}
+        if len(head_dims) != 1:
+            raise ValueError(
+                f"per-block heads {heads} do not share one head_dim over "
+                f"channels {block_out}; not expressible by ModelConfig")
+        out["attention_head_dim"] = head_dims.pop()
+    else:
+        out["attention_num_heads"] = int(heads)
+        out["attention_head_dim"] = 0
+    vae_cfg = ckpt / "vae" / "config.json"
+    if vae_cfg.exists():
+        v = json.loads(vae_cfg.read_text())
+        out.update(
+            vae_block_out_channels=tuple(v["block_out_channels"]),
+            vae_layers_per_block=v.get("layers_per_block", 2),
+            vae_latent_channels=v.get("latent_channels", 4),
+            vae_scaling_factor=v.get("scaling_factor", 0.18215))
+    text_cfg = ckpt / "text_encoder" / "config.json"
+    if text_cfg.exists():
+        t = json.loads(text_cfg.read_text())
+        out.update(
+            text_vocab_size=t.get("vocab_size", 49408),
+            text_hidden_size=t.get("hidden_size", 1024),
+            text_layers=t.get("num_hidden_layers", 23),
+            text_heads=t.get("num_attention_heads", 16),
+            text_max_length=t.get("max_position_embeddings", 77),
+            text_act=t.get("hidden_act", "gelu"))
+    sched_cfg = ckpt / "scheduler" / "scheduler_config.json"
+    if sched_cfg.exists():
+        s = json.loads(sched_cfg.read_text())
+        out.update(
+            num_train_timesteps=s.get("num_train_timesteps", 1000),
+            beta_schedule=s.get("beta_schedule", "scaled_linear"),
+            beta_start=s.get("beta_start", 0.00085),
+            beta_end=s.get("beta_end", 0.012),
+            prediction_type=s.get("prediction_type", "epsilon"))
+    return out
 
 
 def _flatten(tree: Any, prefix: str = "") -> dict[str, np.ndarray]:

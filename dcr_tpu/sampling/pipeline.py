@@ -45,15 +45,20 @@ def load_checkpoint_models(ckpt_dir: str | Path):
     Model shapes come from model_index.json (our serialized ModelConfig)."""
     ckpt_dir = Path(ckpt_dir)
     index = json.loads((ckpt_dir / "model_index.json").read_text())
-    # round-2 exports are diffusers-style model_index.json with our native
-    # ModelConfig nested under "model_config"; round-1 exports were the flat
-    # dict, and their CLIPTextModel hardcoded quick_gelu — preserve those
-    # numerics when the key predates the text_act config field.
-    cfg_dict = index.get("model_config", index)
-    if "model_config" not in index:
-        cfg_dict = {**cfg_dict, "text_act": cfg_dict.get("text_act", "quick_gelu")}
+    if "model_config" in index:
+        # round-2+ export: our native ModelConfig nested under "model_config"
+        cfg_dict = index["model_config"]
+    elif "block_out_channels" in index:
+        # round-1 legacy flat dict, whose CLIPTextModel hardcoded quick_gelu —
+        # preserve those numerics when the key predates the text_act field
+        cfg_dict = {**index, "text_act": index.get("text_act", "quick_gelu")}
+    else:
+        # a GENUINE diffusers checkpoint directory (e.g. downloaded SD-2.1):
+        # infer dims from the per-subfolder config.json files
+        from dcr_tpu.core.checkpoint import model_config_from_diffusers
+
+        cfg_dict = model_config_from_diffusers(ckpt_dir)
     model_cfg = from_dict(ModelConfig, cfg_dict)
-    sched_cfg = json.loads((ckpt_dir / "scheduler" / "scheduler_config.json").read_text())
     params = {
         "unet": import_hf_layout(ckpt_dir, "unet"),
         "vae": import_hf_layout(ckpt_dir, "vae"),
@@ -63,13 +68,56 @@ def load_checkpoint_models(ckpt_dir: str | Path):
         unet=UNet2DCondition(model_cfg),
         vae=AutoencoderKL(model_cfg),
         text_encoder=CLIPTextModel(model_cfg),
+        # model_cfg carries the schedule fields for every checkpoint flavor:
+        # native exports round-trip them; the genuine-diffusers path fills
+        # them from scheduler_config.json (model_config_from_diffusers)
         schedule=S.make_schedule(
-            num_train_timesteps=sched_cfg["num_train_timesteps"],
-            beta_schedule=sched_cfg["beta_schedule"],
-            beta_start=sched_cfg["beta_start"], beta_end=sched_cfg["beta_end"],
-            prediction_type=sched_cfg["prediction_type"]),
+            num_train_timesteps=model_cfg.num_train_timesteps,
+            beta_schedule=model_cfg.beta_schedule,
+            beta_start=model_cfg.beta_start, beta_end=model_cfg.beta_end,
+            prediction_type=model_cfg.prediction_type),
     )
+    _validate_loaded(models, model_cfg, params, ckpt_dir)
     return models, params, model_cfg
+
+
+def _validate_loaded(models: "DiffusionModels", model_cfg: ModelConfig,
+                     params: dict, ckpt_dir: Path) -> None:
+    """Strict structural check of loaded trees against the architectures the
+    config describes (shapes from jax.eval_shape — trace-only, no compute).
+    Catches unsupported checkpoints (wrong dims, SDXL-family leftovers)
+    loudly instead of sampling garbage from a partially-consumed state dict."""
+    import jax.numpy as jnp
+
+    from dcr_tpu.models.convert import check_converted
+
+    key = jax.random.key(0)
+    px = 2 ** (len(model_cfg.vae_block_out_channels) - 1) * model_cfg.sample_size
+    expected = {
+        "unet": jax.eval_shape(
+            models.unet.init, key,
+            jax.ShapeDtypeStruct((1, model_cfg.sample_size,
+                                  model_cfg.sample_size,
+                                  model_cfg.in_channels), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((1, model_cfg.text_max_length,
+                                  model_cfg.cross_attention_dim), jnp.float32),
+        )["params"],
+        "vae": jax.eval_shape(
+            models.vae.init, key,
+            jax.ShapeDtypeStruct((1, px, px, 3), jnp.float32), key)["params"],
+        "text": jax.eval_shape(
+            models.text_encoder.init, key,
+            jax.ShapeDtypeStruct((1, model_cfg.text_max_length), jnp.int32),
+        )["params"],
+    }
+    problems = [f"{comp}{p}" for comp in expected
+                for p in check_converted(expected[comp], params[comp])]
+    if problems:
+        head = "; ".join(problems[:8])
+        raise ValueError(
+            f"checkpoint {ckpt_dir} does not match the architecture its "
+            f"configs describe ({len(problems)} mismatches): {head}")
 
 
 def resolve_checkpoint(cfg: SampleConfig) -> Path:
