@@ -224,19 +224,6 @@ _TORCH_WEIGHT_NAMES = ("diffusion_pytorch_model.safetensors", "model.safetensors
                        "diffusion_pytorch_model.fp16.bin", "pytorch_model.fp16.bin")
 
 
-def _load_torch_sd(path: Path) -> dict[str, np.ndarray]:
-    if path.suffix == ".safetensors":
-        from safetensors.numpy import load_file
-
-        return load_file(str(path))
-    import torch
-
-    from dcr_tpu.models.convert import torch_state_dict_to_numpy
-
-    return torch_state_dict_to_numpy(
-        torch.load(str(path), map_location="cpu", weights_only=True))
-
-
 def import_hf_layout(ckpt_dir: str | Path, component: str) -> dict:
     """Load one component's Flax params from an HF-layout checkpoint dir.
 
@@ -261,7 +248,7 @@ def import_hf_layout(ckpt_dir: str | Path, component: str) -> dict:
             f"under {sub_dir}")
     from dcr_tpu.models import convert as CV
 
-    sd = _load_torch_sd(weight_file)
+    sd = CV.load_torch_file(weight_file)
     cfg = json.loads((sub_dir / "config.json").read_text())
     if component == "unet":
         return CV.convert_unet(
@@ -339,7 +326,10 @@ def model_config_from_diffusers(ckpt_dir: str | Path) -> dict:
             text_layers=t.get("num_hidden_layers", 23),
             text_heads=t.get("num_attention_heads", 16),
             text_max_length=t.get("max_position_embeddings", 77),
-            text_act=t.get("hidden_act", "gelu"))
+            # transformers serializes configs as diffs from defaults, and
+            # CLIPTextConfig's default is quick_gelu — an omitted key means
+            # quick_gelu, not gelu
+            text_act=t.get("hidden_act", "quick_gelu"))
     sched_cfg = ckpt / "scheduler" / "scheduler_config.json"
     if sched_cfg.exists():
         s = json.loads(sched_cfg.read_text())

@@ -264,6 +264,12 @@ class EvalConfig:
     gallery_rows: int = 10
     gallery_max_rank: int = 200
     dup_weights_pickle: str = ""           # training sampling-weights file
+    # pretrained checkpoint files (torch state dicts / TorchScript archives /
+    # safetensors), converted on load via models/convert.py; empty = random
+    # init (and metrics are NOT comparable to reference numbers)
+    weights_path: str = ""                 # copy-detection backbone (SSCD/DINO/CLIP)
+    inception_weights_path: str = ""       # pt_inception-2015-12-05 for FID
+    clip_weights_path: str = ""            # OpenAI CLIP archive for the alignment score
     output_dir: str = "ret_plots"
     use_wandb: bool = False                # wandb sink (jsonl/tb always on)
     seed: int = 42

@@ -52,31 +52,65 @@ from dcr_tpu.parallel import mesh as pmesh
 log = logging.getLogger("dcr_tpu")
 
 
+def load_backbone_params(pt_style: str, arch: str, path: str) -> dict:
+    """Reference checkpoint file -> converted Flax params for build_backbone
+    (SSCD TorchScript, DINO hub .pth, OpenAI CLIP / transformers archives)."""
+    from dcr_tpu.models import convert as CV
+
+    sd = CV.load_torch_file(path)
+    if pt_style == "sscd":
+        return CV.convert_sscd(sd)
+    if pt_style == "dino":
+        if arch == "dino_resnet50":
+            return {"backbone": CV.convert_resnet50(sd)}
+        return CV.convert_dino_vit(sd)
+    if pt_style == "clip":
+        return CV.convert_clip_image(sd)
+    raise ValueError(f"unknown pt_style {pt_style!r}")
+
+
+def _validate_backbone(model, params: dict, image_size: int) -> None:
+    """Shape-check supplied params against the architecture (trace-only).
+    Positional tables don't vary with image_size here (DINO/CLIP size theirs
+    from their own config and interpolate at apply time), so a full strict
+    check is safe."""
+    import jax.numpy as jnp
+
+    from dcr_tpu.models.convert import check_converted
+
+    expected = jax.eval_shape(
+        model.init, jax.random.key(0),
+        jax.ShapeDtypeStruct((1, image_size, image_size, 3), jnp.float32))["params"]
+    problems = check_converted(expected, params)
+    if problems:
+        raise ValueError(
+            f"backbone weights do not match the architecture "
+            f"({len(problems)} mismatches): {'; '.join(problems[:8])}")
+
+
 def build_backbone(pt_style: str, arch: str, key: jax.Array,
                    params: Optional[dict] = None, image_size: int = 224):
     """(apply_fn, params) for the copy-detection embedder
     (reference model zoo switch, diff_retrieval.py:249-285). Random init unless
-    converted pretrained params are supplied (models/convert.py)."""
+    converted pretrained params are supplied (models/convert.py or
+    load_backbone_params); supplied params are shape-validated."""
     import jax.numpy as jnp
 
     if pt_style == "sscd":
         model = SSCDModel(embed_dim=512)
-        if params is None:
-            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
-        return (lambda p, x: model.apply({"params": p}, x)), params
-    if pt_style == "dino":
+    elif pt_style == "dino":
         if arch not in DINO_ARCHS:
             raise ValueError(f"unknown dino arch {arch!r} (have {sorted(DINO_ARCHS)})")
         model = DINO_ARCHS[arch]()
-        if params is None:
-            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
-        return (lambda p, x: model.apply({"params": p}, x)), params
-    if pt_style == "clip":
+    elif pt_style == "clip":
         model = CLIPImageTower()
-        if params is None:
-            params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
-        return (lambda p, x: model.apply({"params": p}, x)), params
-    raise ValueError(f"unknown pt_style {pt_style!r} (sscd | dino | clip)")
+    else:
+        raise ValueError(f"unknown pt_style {pt_style!r} (sscd | dino | clip)")
+    if params is None:
+        params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
+    else:
+        _validate_backbone(model, params, image_size)
+    return (lambda p, x: model.apply({"params": p}, x)), params
 
 
 def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
@@ -147,6 +181,11 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                              normalize=HALF_NORM, caption_json=values_caption_json)
     log.info("eval: %d query (gen) vs %d values (train)", len(query), len(values))
 
+    if backbone_params is None and cfg.weights_path:
+        log.info("loading %s backbone weights from %s", cfg.pt_style,
+                 cfg.weights_path)
+        backbone_params = load_backbone_params(cfg.pt_style, cfg.arch,
+                                               cfg.weights_path)
     apply_fn, params = build_backbone(cfg.pt_style, cfg.arch, jax.random.key(0),
                                       backbone_params, cfg.image_size)
     extractor = make_extractor(apply_fn, params, mesh, multiscale=cfg.multiscale)
@@ -172,8 +211,16 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
         G.histogram_plot(stats.top1, bg, out_dir / "histogram.png")
 
     if cfg.compute_clip_score:
-        scalars["gen_clipscore"] = clip_alignment_score(query, tokenizer, mesh)
-        scalars["train_clipscore"] = clip_alignment_score(values, tokenizer, mesh)
+        scorer_params = None
+        if cfg.clip_weights_path:
+            from dcr_tpu.models.convert import convert_openai_clip, load_torch_file
+
+            scorer_params = convert_openai_clip(
+                load_torch_file(cfg.clip_weights_path))
+        scalars["gen_clipscore"] = clip_alignment_score(
+            query, tokenizer, mesh, scorer_params=scorer_params)
+        scalars["train_clipscore"] = clip_alignment_score(
+            values, tokenizer, mesh, scorer_params=scorer_params)
 
     if cfg.compute_complexity:
         match_images = [values.load(i) for i in stats.top1_index]
@@ -201,6 +248,11 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
     if cfg.compute_fid:
         inception = InceptionV3FID()
+        if inception_params is None and cfg.inception_weights_path:
+            from dcr_tpu.models.convert import convert_inception_fid, load_torch_file
+
+            inception_params = convert_inception_fid(
+                load_torch_file(cfg.inception_weights_path))
         if inception_params is None:
             inception_params = inception.init(
                 jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]

@@ -39,6 +39,25 @@ def torch_state_dict_to_numpy(module_or_sd) -> dict[str, Arr]:
             for k, v in sd.items()}
 
 
+def load_torch_file(path) -> dict[str, Arr]:
+    """Checkpoint file of any reference-relevant flavor -> numpy state dict:
+    safetensors, torch state-dict .pth/.pt, or a TorchScript archive (the SSCD
+    distribution format, diff_retrieval.py:277-285). Single loader shared by
+    the checkpoint importer and the eval runner."""
+    p = str(path)
+    if p.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(p)
+    import torch
+
+    try:
+        obj = torch.load(p, map_location="cpu", weights_only=True)
+    except Exception:
+        obj = torch.jit.load(p, map_location="cpu")
+    return torch_state_dict_to_numpy(obj)
+
+
 def conv_kernel(w: Arr) -> Arr:
     return np.transpose(w, (2, 3, 1, 0))
 

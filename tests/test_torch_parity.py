@@ -231,3 +231,30 @@ def test_vgg16_matches_torch_twin():
     # unnormalized random-weight activations reach ~5e3; 0.05 abs ≈ 1e-5 rel
     np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
                                atol=0.05, rtol=1e-3)
+
+
+def test_sscd_torchscript_file_drop(tmp_path):
+    """The SSCD distribution format is a TorchScript archive
+    (diff_retrieval.py:277-285). Trace the torch twin, save a real
+    .torchscript.pt, and load it through the eval runner's weights_path
+    machinery — features must match the torch module."""
+    from dcr_tpu.eval.runner import build_backbone, load_backbone_params
+    from tests.fixtures.torch_backbones import TorchSSCD
+
+    twin = TorchSSCD().eval()
+    _randomize(twin, 6)
+    example = torch.zeros(1, 3, 64, 64)
+    traced = torch.jit.trace(twin, example)
+    path = tmp_path / "sscd_disc_mixup.torchscript.pt"
+    traced.save(str(path))
+
+    params = load_backbone_params("sscd", "resnet50_disc", str(path))
+    apply_fn, params = build_backbone("sscd", "resnet50_disc",
+                                     jax.random.key(0), params, 64)
+    rng = np.random.default_rng(6)
+    img = rng.standard_normal((2, 64, 64, 3)).astype(np.float32)
+    ours = apply_fn(params, jnp.asarray(img))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               atol=2e-4, rtol=1e-3)
