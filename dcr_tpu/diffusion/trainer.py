@@ -36,21 +36,54 @@ from dcr_tpu.parallel import mesh as pmesh
 log = logging.getLogger("dcr_tpu")
 
 
-def build_models(cfg: TrainConfig, key: jax.Array, mesh=None):
-    """Initialize the module bundle + params (random init; finetuning loads a
-    converted checkpoint over these via models/convert.py). Passing the mesh
-    enables ring-attention sequence parallelism in the UNet when its seq axis
-    is >1 (cfg.model.seq_parallel_min_seq)."""
-    ku, kv, kt = jax.random.split(key, 3)
-    unet, unet_params = init_unet(cfg.model, ku, mesh=mesh)
-    vae, vae_params = init_vae(cfg.model, kv)
-    text, text_params = init_clip_text(cfg.model, kt)
+def build_modules(cfg: TrainConfig, mesh=None) -> "T.DiffusionModels":
+    """Construct the module bundle WITHOUT initializing any params.
+
+    Module objects are static pytree-less config holders; the only arrays here
+    are the (tiny) noise-schedule tables. Pairs with abstract_train_state for
+    zero-memory cost-analysis lowering (bench.py FLOPs accounting)."""
+    from dcr_tpu.models.clip_text import CLIPTextModel
+    from dcr_tpu.models.unet2d import UNet2DCondition
+    from dcr_tpu.models.vae import AutoencoderKL
+
     sched = S.make_schedule(
         num_train_timesteps=cfg.model.num_train_timesteps,
         beta_schedule=cfg.model.beta_schedule,
         beta_start=cfg.model.beta_start, beta_end=cfg.model.beta_end,
         prediction_type=cfg.model.prediction_type)
-    models = T.DiffusionModels(unet=unet, vae=vae, text_encoder=text, schedule=sched)
+    return T.DiffusionModels(
+        unet=UNet2DCondition(cfg.model, dtype=jnp.float32, mesh=mesh),
+        vae=AutoencoderKL(cfg.model, dtype=jnp.float32),
+        text_encoder=CLIPTextModel(cfg.model, dtype=jnp.float32),
+        schedule=sched)
+
+
+def abstract_train_state(cfg: TrainConfig, key: Optional[jax.Array] = None) -> "T.TrainState":
+    """Shape-only TrainState (ShapeDtypeStruct leaves, zero device memory).
+
+    Runs the full build_models + init_train_state pipeline under
+    jax.eval_shape, so optimizer/EMA slots match the real thing exactly.
+    Used to lower the train step for XLA cost analysis without allocating
+    the ~GBs of SD-2.1 params."""
+    def mk(k):
+        models, params = build_models(cfg, k)
+        return T.init_train_state(cfg, models, unet_params=params["unet"],
+                                  text_params=params["text"],
+                                  vae_params=params["vae"])
+
+    return jax.eval_shape(mk, key if key is not None else jax.random.key(0))
+
+
+def build_models(cfg: TrainConfig, key: jax.Array, mesh=None):
+    """Initialize the module bundle + params (random init; finetuning loads a
+    converted checkpoint over these via models/convert.py). Passing the mesh
+    enables ring-attention sequence parallelism in the UNet when its seq axis
+    is >1 (cfg.model.seq_parallel_min_seq)."""
+    models = build_modules(cfg, mesh=mesh)
+    ku, kv, kt = jax.random.split(key, 3)
+    _, unet_params = init_unet(cfg.model, ku, model=models.unet)
+    _, vae_params = init_vae(cfg.model, kv, model=models.vae)
+    _, text_params = init_clip_text(cfg.model, kt, model=models.text_encoder)
     return models, {"unet": unet_params, "vae": vae_params, "text": text_params}
 
 

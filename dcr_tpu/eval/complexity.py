@@ -66,23 +66,60 @@ def pearson(x: Sequence[float], y: Sequence[float]) -> float:
     return float(np.corrcoef(x, y)[0, 1])
 
 
-def complexity_correlations(match_images: Sequence[np.ndarray],
-                            top1_sims: Sequence[float]) -> tuple[dict, dict]:
-    """The reference's four wandb scalars (diff_retrieval.py:530-540):
-    correlations of top-1 similarity with entropy / jpeg size / tv / all pairs.
-    Returns (scalars, per_image_series) so callers can reuse the series for
-    scatter plots without recomputing."""
-    entropies = [shannon_entropy(im) for im in match_images]
-    sizes = [float(jpeg_size(im)) for im in match_images]
-    tvs = [tv_loss(im) for im in match_images]
-    scalars = {
+def complexity_triple(image: np.ndarray) -> tuple[float, float, float]:
+    """(entropy, jpeg_bytes, tv) of one image — the three reference proxies."""
+    return shannon_entropy(image), float(jpeg_size(image)), tv_loss(image)
+
+
+def streamed_series(load, indices, *, workers: int = 8) -> dict:
+    """Complexity series over top-1 match indices, LAION-scale-safe.
+
+    The reference materializes every match image in a python list before
+    measuring (diff_retrieval.py:497-559, mirrored by run_eval pre-round-3);
+    at 100k+ generations that is tens of GB of host RAM. Here each *unique*
+    match index is loaded once (threaded — decode is the bottleneck), reduced
+    to its three scalars immediately, and the per-generation series are
+    recovered through the inverse map. Peak memory: `workers` decoded images
+    + three float64 arrays.
+    """
+    from concurrent.futures import ThreadPoolExecutor
+
+    uniq, inverse = np.unique(np.asarray(indices, np.int64), return_inverse=True)
+    if len(uniq) == 0:
+        empty = np.zeros((0,), np.float64)
+        return {"entropy": empty, "jpeg_bytes": empty, "tv": empty}
+    with ThreadPoolExecutor(max_workers=max(1, workers)) as ex:
+        triples = list(ex.map(lambda i: complexity_triple(load(int(i))), uniq))
+    t = np.asarray(triples, np.float64)[inverse]            # [N, 3]
+    return {"entropy": t[:, 0], "jpeg_bytes": t[:, 1], "tv": t[:, 2]}
+
+
+def correlations_from_series(series: dict, top1_sims) -> dict:
+    """The reference's wandb scalars (diff_retrieval.py:530-540): correlations
+    of top-1 similarity with entropy / jpeg size / tv / entropy-vs-size."""
+    entropies, sizes, tvs = series["entropy"], series["jpeg_bytes"], series["tv"]
+    return {
         "corr_entropy_sim": pearson(entropies, top1_sims),
         "corr_jpegsize_sim": pearson(sizes, top1_sims),
         "corr_tv_sim": pearson(tvs, top1_sims),
         "corr_entropy_jpegsize": pearson(entropies, sizes),
-        "mean_entropy": float(np.mean(entropies)) if entropies else float("nan"),
-        "mean_jpeg_bytes": float(np.mean(sizes)) if sizes else float("nan"),
-        "mean_tv": float(np.mean(tvs)) if tvs else float("nan"),
+        "mean_entropy": float(np.mean(entropies)) if len(entropies) else float("nan"),
+        "mean_jpeg_bytes": float(np.mean(sizes)) if len(sizes) else float("nan"),
+        "mean_tv": float(np.mean(tvs)) if len(tvs) else float("nan"),
     }
-    series = {"entropy": entropies, "jpeg_bytes": sizes, "tv": tvs}
-    return scalars, series
+
+
+def complexity_correlations(match_images: Sequence[np.ndarray],
+                            top1_sims: Sequence[float]) -> tuple[dict, dict]:
+    """Single-pass variant over in-memory images (small-scale callers/tests).
+    Returns (scalars, per_image_series) so callers can reuse the series for
+    scatter plots without recomputing. run_eval uses streamed_series instead."""
+    entropies, sizes, tvs = [], [], []
+    for im in match_images:
+        e, s, t = complexity_triple(im)
+        entropies.append(e)
+        sizes.append(s)
+        tvs.append(t)
+    series = {"entropy": np.asarray(entropies), "jpeg_bytes": np.asarray(sizes),
+              "tv": np.asarray(tvs)}
+    return correlations_from_series(series, top1_sims), series

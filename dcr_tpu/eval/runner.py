@@ -69,6 +69,19 @@ def load_backbone_params(pt_style: str, arch: str, path: str) -> dict:
     raise ValueError(f"unknown pt_style {pt_style!r}")
 
 
+def _validate_params(expected, params, what: str) -> None:
+    """Shape-check supplied params against an eval_shape-derived expected tree
+    so a wrong weights file fails with a clear mismatch message instead of an
+    opaque flax apply error deep in the metric loop."""
+    from dcr_tpu.models.convert import check_converted
+
+    problems = check_converted(expected, params)
+    if problems:
+        raise ValueError(
+            f"{what} weights do not match the architecture "
+            f"({len(problems)} mismatches): {'; '.join(problems[:8])}")
+
+
 def _validate_backbone(model, params: dict, image_size: int) -> None:
     """Shape-check supplied params against the architecture (trace-only).
     Positional tables don't vary with image_size here (DINO/CLIP size theirs
@@ -76,16 +89,10 @@ def _validate_backbone(model, params: dict, image_size: int) -> None:
     check is safe."""
     import jax.numpy as jnp
 
-    from dcr_tpu.models.convert import check_converted
-
     expected = jax.eval_shape(
         model.init, jax.random.key(0),
         jax.ShapeDtypeStruct((1, image_size, image_size, 3), jnp.float32))["params"]
-    problems = check_converted(expected, params)
-    if problems:
-        raise ValueError(
-            f"backbone weights do not match the architecture "
-            f"({len(problems)} mismatches): {'; '.join(problems[:8])}")
+    _validate_params(expected, params, "backbone")
 
 
 def build_backbone(pt_style: str, arch: str, key: jax.Array,
@@ -217,15 +224,23 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
             scorer_params = convert_openai_clip(
                 load_torch_file(cfg.clip_weights_path))
+            scorer = make_clip_scorer()
+            _validate_params(
+                jax.eval_shape(lambda k: init_clip_scorer(k, scorer),
+                               jax.random.key(0)),
+                scorer_params, "CLIP scorer")
         scalars["gen_clipscore"] = clip_alignment_score(
             query, tokenizer, mesh, scorer_params=scorer_params)
         scalars["train_clipscore"] = clip_alignment_score(
             values, tokenizer, mesh, scorer_params=scorer_params)
 
     if cfg.compute_complexity:
-        match_images = [values.load(i) for i in stats.top1_index]
-        cx, series = CX.complexity_correlations(match_images, stats.top1)
-        scalars.update(cx)
+        # de-duplicated streaming measurement: unique match images are decoded
+        # once and reduced to scalars immediately — bounded host memory at
+        # LAION scale (the reference holds every match image in a list,
+        # diff_retrieval.py:497-559)
+        series = CX.streamed_series(values.load, stats.top1_index)
+        scalars.update(CX.correlations_from_series(series, stats.top1))
         if dist.is_primary():
             G.scatter_plot(np.asarray(series["entropy"]), stats.top1,
                            "match entropy", "top1 sim",
@@ -253,6 +268,11 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
             inception_params = convert_inception_fid(
                 load_torch_file(cfg.inception_weights_path))
+            _validate_params(
+                jax.eval_shape(
+                    inception.init, jax.random.key(0),
+                    jax.ShapeDtypeStruct((1, 299, 299, 3), jnp.float32))["params"],
+                inception_params, "FID Inception")
         if inception_params is None:
             inception_params = inception.init(
                 jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]

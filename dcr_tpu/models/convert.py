@@ -53,8 +53,16 @@ def load_torch_file(path) -> dict[str, Arr]:
 
     try:
         obj = torch.load(p, map_location="cpu", weights_only=True)
-    except Exception:
-        obj = torch.jit.load(p, map_location="cpu")
+    except Exception as e:
+        try:
+            obj = torch.jit.load(p, map_location="cpu")
+        except Exception as jit_e:
+            # keep the original torch.load failure visible — a corrupt or
+            # weights_only-incompatible state dict should not surface as a
+            # confusing TorchScript error with its real cause discarded
+            raise RuntimeError(
+                f"{p!r} is neither a loadable state dict ({e!r}) nor a "
+                f"TorchScript archive") from jit_e
     return torch_state_dict_to_numpy(obj)
 
 

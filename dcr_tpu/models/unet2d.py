@@ -111,12 +111,14 @@ class UNet2DCondition(nn.Module):
         return h.astype(jnp.float32)
 
 
-def init_unet(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32, mesh=None):
+def init_unet(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32, mesh=None,
+              model: "UNet2DCondition | None" = None):
     """Initialize params with tiny dummy shapes (shape-polymorphic in H/W).
     `mesh` (seq axis >1) turns on ring-attention sequence parallelism; init
     itself always runs the single-chip path (batch-1 dummy shapes never pass
-    the divisibility gate)."""
-    model = UNet2DCondition(cfg, dtype=dtype, mesh=mesh)
+    the divisibility gate). Pass `model` to init a prebuilt module
+    (trainer.build_modules) instead of constructing a second one."""
+    model = model if model is not None else UNet2DCondition(cfg, dtype=dtype, mesh=mesh)
     sample = jnp.zeros((1, cfg.sample_size, cfg.sample_size, cfg.in_channels))
     t = jnp.zeros((1,), jnp.int32)
     ctx = jnp.zeros((1, cfg.text_max_length, cfg.cross_attention_dim))

@@ -120,8 +120,9 @@ def vae_scale_factor(cfg: ModelConfig) -> int:
     return 2 ** (len(cfg.vae_block_out_channels) - 1)
 
 
-def init_vae(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32):
-    model = AutoencoderKL(cfg, dtype=dtype)
+def init_vae(cfg: ModelConfig, key: jax.Array, dtype=jnp.float32,
+             model: "AutoencoderKL | None" = None):
+    model = model if model is not None else AutoencoderKL(cfg, dtype=dtype)
     px = vae_scale_factor(cfg) * cfg.sample_size
     x = jnp.zeros((1, px, px, 3))
     params = model.init(key, x, jax.random.key(0))["params"]

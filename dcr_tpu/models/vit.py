@@ -87,7 +87,8 @@ def _bicubic_axis(out_size: int, in_size: int, scale: float):
 
 
 def interpolate_pos_embed(pos_embed: jax.Array, num_patches: int,
-                          grid_hw: tuple[int, int]) -> jax.Array:
+                          grid_hw: tuple[int, int],
+                          pixel_hw: Optional[tuple[int, int]] = None) -> jax.Array:
     """Bicubic interpolation of the patch position table to a new grid —
     lets one checkpoint serve any input resolution. Numerically identical to
     the reference's torch path (dino_vits.py:213-233: scale factors carry the
@@ -96,9 +97,12 @@ def interpolate_pos_embed(pos_embed: jax.Array, num_patches: int,
     cls_pos, patch_pos = pos_embed[:, :1], pos_embed[:, 1:]
     n_orig = patch_pos.shape[1]
     h, w = grid_hw
-    # a non-square grid must interpolate even at matching patch count — the
-    # table is laid out square (reference condition dino_vits.py:216)
-    if n_orig == num_patches and h == w:
+    # the skip condition tests *pixel* squareness, not grid squareness
+    # (reference `npatch == N and w == h` on pixel dims, dino_vits.py:216):
+    # a non-square pixel input whose floored grid happens square (e.g. 32x39,
+    # patch 8) still takes the (near-identity) interpolation path
+    ph, pw = pixel_hw if pixel_hw is not None else (h, w)
+    if n_orig == num_patches and ph == pw:
         return pos_embed
     side = int(math.sqrt(n_orig))
     grid = patch_pos.reshape(side, side, -1)
@@ -136,7 +140,7 @@ class VisionTransformer(nn.Module):
         max_grid = self.img_size // self.patch_size
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, max_grid * max_grid + 1, self.embed_dim))
-        pos = interpolate_pos_embed(pos, gh * gw, (gh, gw))
+        pos = interpolate_pos_embed(pos, gh * gw, (gh, gw), pixel_hw=(h, w))
         tokens = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.embed_dim)),
                                   tokens], axis=1) + pos.astype(self.dtype)
         outputs = []

@@ -20,7 +20,8 @@ jax.config.update("jax_threefry_partitionable", True)
 # closure -> new jit object). Cache survives across tests AND across runs.
 from pathlib import Path  # noqa: E402
 
-_cache = Path(__file__).parent / ".jax_cache_cpu"
+_cache = Path(os.environ.get("DCR_TEST_CACHE_DIR")
+              or Path(__file__).parent / ".jax_cache_cpu")
 jax.config.update("jax_compilation_cache_dir", str(_cache))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
@@ -32,6 +33,30 @@ except Exception:
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+
+_cache_before: set = set()
+
+
+def pytest_sessionstart(session):
+    global _cache_before
+    _cache_before = {p.name for p in _cache.glob("*")} if _cache.exists() else set()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Cache hit/miss accounting: entries present before the session that the
+    run did NOT touch are prune candidates (an entry is rewritten/refreshed on
+    miss, so `new` counts this run's compiles). Regenerate the committed cache
+    with DCR_TEST_CACHE_DIR=<fresh dir> + a full run, then swap directories."""
+    if not _cache.exists():
+        return
+    now = {p.name for p in _cache.glob("*")}
+    new = now - _cache_before
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.write_line(
+            f"jax compile cache [{_cache.name}]: {len(now)} entries, "
+            f"{len(new)} written this run (cache misses)")
 
 
 @pytest.fixture(scope="session")

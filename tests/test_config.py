@@ -2,6 +2,8 @@ import json
 
 import pytest
 
+pytestmark = pytest.mark.fast
+
 from dcr_tpu.core import config as C
 
 

@@ -3,6 +3,8 @@ import pickle
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.fast
 from PIL import Image
 
 from dcr_tpu.core.config import DataConfig

@@ -1,6 +1,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast
+
 from dcr_tpu.eval import complexity as CX
 from dcr_tpu.eval import fid as FID
 from dcr_tpu.eval import ipr as IPR
@@ -125,6 +127,33 @@ def test_complexity_measures():
     corr = CX.pearson([1, 2, 3, 4], [2, 4, 6, 8])
     assert corr == pytest.approx(1.0)
     assert np.isnan(CX.pearson([1, 1], [2, 3]))
+
+
+def test_streamed_series_dedups_loads_and_matches_direct(rng_np):
+    """LAION-scale complexity path: 100k top-1 indices over 8 unique match
+    images must decode each unique image exactly once (bounded memory /
+    bounded IO) and agree elementwise with the in-memory single-pass path."""
+    images = [rng_np.uniform(0, 1, (16, 16, 3)).astype(np.float32)
+              for _ in range(8)]
+    loads: list[int] = []
+
+    def load(i: int):
+        loads.append(i)
+        return images[i]
+
+    indices = rng_np.integers(0, 8, size=100_000)
+    series = CX.streamed_series(load, indices, workers=4)
+    assert sorted(loads) == list(range(8))          # one decode per unique match
+    assert all(v.shape == (100_000,) for v in series.values())
+    _, direct = CX.complexity_correlations([images[i] for i in indices[:64]],
+                                           np.zeros(64))
+    for k in ("entropy", "jpeg_bytes", "tv"):
+        np.testing.assert_allclose(series[k][:64], direct[k], rtol=1e-12)
+
+
+def test_streamed_series_empty():
+    series = CX.streamed_series(lambda i: None, np.zeros((0,), np.int64))
+    assert all(len(v) == 0 for v in series.values())
 
 
 def test_complexity_correlations_keys(rng_np):

@@ -15,6 +15,10 @@ from tests.fixtures.reference_schedulers import (
     RefDPMSolverMultistepScheduler,
 )
 
+import pytest
+
+pytestmark = pytest.mark.fast
+
 SHAPE = (1, 4, 4, 2)
 
 

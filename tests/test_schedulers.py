@@ -4,6 +4,10 @@ import numpy as np
 
 from dcr_tpu.models import schedulers as S
 
+import pytest
+
+pytestmark = pytest.mark.fast
+
 
 def _sched(pred="epsilon"):
     return S.make_schedule(prediction_type=pred)

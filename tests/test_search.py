@@ -4,6 +4,8 @@ import tarfile
 
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.fast
 from PIL import Image
 
 from dcr_tpu.core.config import SearchConfig

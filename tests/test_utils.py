@@ -1,6 +1,8 @@
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.fast
+
 from dcr_tpu.eval import retrieval_metrics as RM
 from dcr_tpu.utils import profiling, provenance
 

@@ -1,0 +1,131 @@
+"""Cross-check the flash-kernel timing method (VERDICT r2 'what's weak' #3).
+
+SWEEP_FLASH.jsonl's numbers come from the host-fetch *slope* method
+(timeit in tools/sweep_flash.py: (t(1+N) - t(1)) / N, cancelling the ~174ms
+tunnel round-trip). Round 1 taught us bespoke timing methods can be entirely
+wrong (block_until_ready was a no-op on this backend), so this tool times the
+same ops with an INDEPENDENT second method and reports both:
+
+- slope:  N un-chained dispatches, one host fetch, slope over N.
+- scan:   a single jitted lax.scan of length N whose carry chains each
+          attention output into the next call's query — XLA cannot overlap or
+          elide iterations, the whole chain is one dispatch, and the wall time
+          of fetching the final carry divided by N bounds per-op time from
+          above (includes scan overhead, so scan >= truth >= slope modulo
+          dispatch pipelining).
+
+Agreement within ~10% validates the sweep table. Appends one JSON object per
+(shape, impl) to CHECK_FLASH_TIMING.jsonl.
+
+Usage: python tools/check_flash_timing.py   (on a box where jax sees the TPU)
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+OUT = Path(__file__).resolve().parent.parent / "CHECK_FLASH_TIMING.jsonl"
+
+# three representative SWEEP_FLASH shapes: in-model 256px, in-model 512px,
+# long-context
+SHAPES = [  # (B, H, S, D)
+    (4, 5, 1024, 64),
+    (4, 10, 4096, 64),
+    (1, 5, 16384, 64),
+]
+SCAN_LEN = 20
+
+
+def emit(rec: dict) -> None:
+    rec["t"] = time.strftime("%H:%M:%S")
+    with OUT.open("a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def _sync(out) -> None:
+    leaf = jax.tree.leaves(out)[0]
+    np.asarray(leaf.ravel()[:1])
+
+
+def time_slope(fn, *args, iters: int = 20) -> float:
+    """ms/iter, method 1 (identical to tools/sweep_flash.py::timeit)."""
+
+    def run(n: int) -> float:
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(n):
+            out = fn(*args)
+        _sync(out)
+        return time.perf_counter() - t0
+
+    run(2)
+    t1 = min(run(1) for _ in range(3))
+    tn = min(run(1 + iters) for _ in range(3))
+    return max(tn - t1, 0.0) / iters * 1e3
+
+
+def time_scan(fn, q, k, v, length: int = SCAN_LEN) -> float:
+    """ms/iter, method 2: one dispatch of a length-N chained scan."""
+
+    @jax.jit
+    def chained(q0):
+        def body(carry, _):
+            # carry feeds the next query: a real data dependency every step
+            return fn(carry, k, v).astype(carry.dtype), None
+
+        out, _ = jax.lax.scan(body, q0, None, length=length)
+        return out
+
+    _sync(chained(q))                       # compile + warmup
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(chained(q))
+        times.append(time.perf_counter() - t0)
+    # subtract one measured round-trip (a trivial fetch) from the wall time
+    t0 = time.perf_counter()
+    _sync(jnp.zeros((1,)))
+    rtt = time.perf_counter() - t0
+    return max(min(times) - rtt, 0.0) / length * 1e3
+
+
+def main() -> None:
+    from dcr_tpu.ops import flash_attention as fa
+
+    emit({"phase": "devices", "devices": [str(d) for d in jax.devices()]})
+    rng = np.random.default_rng(0)
+
+    for (b, h, s, d) in SHAPES:
+        q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        impls = {
+            "flash": jax.jit(functools.partial(fa.flash_attention)),
+            "xla": jax.jit(lambda q, k, v: jax.nn.dot_product_attention(q, k, v)),
+        }
+        for name, fn in impls.items():
+            try:
+                slope_ms = time_slope(fn, q, k, v)
+                scan_ms = time_scan(fn, q, k, v)
+                ratio = scan_ms / slope_ms if slope_ms > 0 else float("inf")
+                emit({"phase": "timing", "impl": name, "b": b, "h": h, "s": s,
+                      "d": d, "slope_ms": round(slope_ms, 3),
+                      "scan_ms": round(scan_ms, 3), "scan_over_slope": round(ratio, 3)})
+            except Exception as e:
+                emit({"phase": "error", "impl": name, "b": b, "h": h, "s": s,
+                      "error": repr(e)[:300]})
+
+
+if __name__ == "__main__":
+    main()
