@@ -56,7 +56,9 @@ def main(argv=None) -> None:
         tokenizer = load_tokenizer(cfg.model_path or None)
         rng = host_python_rng(cfg.seed, "mitigation_augs")
         prompts = [prompt_augmentation(p, cfg.rand_augs, tokenizer=tokenizer,
-                                       rng=rng) for p in prompts]
+                                       rng=rng,
+                                       repeat_num=cfg.rand_aug_repeats)
+                   for p in prompts]
         cfg.rand_augs = "none"  # already applied; don't re-gate in generate()
     out = generate(cfg, modelstyle="fixed", prompts=prompts)
     logging.getLogger("dcr_tpu").info("mitigation generations -> %s", out)

@@ -91,11 +91,16 @@ class ModelConfig:
     use_linear_projection: bool = True
     norm_num_groups: int = 32
     flash_attention: bool = True       # Pallas kernel when on TPU, XLA fallback otherwise
-    # Spatial self-attention switches to ring attention (K/V rotating over the
-    # mesh's `seq` axis, ops/ring_attention.py) when the token count reaches
-    # this AND the mesh's seq axis is >1. 4096 = 512px latents, where the S×S
-    # weight tensor stops fitting comfortably on one chip.
+    # Spatial self-attention switches to sequence/context parallelism over the
+    # mesh's `seq` axis when the token count reaches this AND the mesh's seq
+    # axis is >1. 4096 = 512px latents, where the S×S weight tensor stops
+    # fitting comfortably on one chip.
     seq_parallel_min_seq: int = 4096
+    # "ring" (K/V rotate via ppermute, ops/ring_attention.py) or "ulysses"
+    # (all_to_all seq<->heads re-shard, full-sequence flash per head group,
+    # ops/ulysses_attention.py; needs heads % seq == 0, else falls back to
+    # ring at the dispatch site).
+    seq_parallel_mode: str = "ring"
     # VAE
     vae_block_out_channels: tuple[int, ...] = (128, 256, 512, 512)
     vae_layers_per_block: int = 2
@@ -239,6 +244,7 @@ class SampleConfig:
     # inference-time mitigations
     rand_noise_lam: float = 0.0            # gaussian noise on prompt embeddings
     rand_augs: str = "none"                # INFERENCE_AUGS
+    rand_aug_repeats: int = 2              # reference diff_inference.py:218
     mesh: MeshConfig = field(default_factory=MeshConfig)
 
 
@@ -443,3 +449,5 @@ def validate_train_config(cfg: TrainConfig) -> None:
     if d.trainspecial != "none" and d.class_prompt != "instancelevel_blip":
         # caption mitigations are blip-captions-only (reference diff_train.py:741-743)
         raise ValueError("trainspecial mitigations require class_prompt=instancelevel_blip")
+    if cfg.model.seq_parallel_mode not in ("ring", "ulysses"):
+        raise ValueError("seq_parallel_mode must be 'ring' or 'ulysses'")

@@ -99,10 +99,17 @@ class CrossAttention(nn.Module):
     """Multi-head attention; self-attention when context is None.
 
     When a mesh with a seq axis >1 is attached and the (self-attention)
-    sequence reaches seq_parallel_min_seq, dispatches to exact ring attention
-    over the mesh's `seq` axis (ops/ring_attention.py) — the long-context
-    path (SURVEY §5.7; reference's only analogue is single-GPU xformers,
-    diff_train.py:578)."""
+    sequence reaches seq_parallel_min_seq, dispatches to exact sequence/
+    context parallelism over the mesh's `seq` axis — the long-context path
+    (SURVEY §5.7; reference's only analogue is single-GPU xformers,
+    diff_train.py:578). Two strategies, selected by seq_parallel_mode:
+
+    - "ring": K/V shards rotate via ppermute, online-softmax merge
+      (ops/ring_attention.py). No head-count constraint.
+    - "ulysses": one all_to_all re-shards seq->heads, full-sequence
+      attention per head group (riding the Pallas flash kernel on TPU),
+      all_to_all back (ops/ulysses_attention.py). Needs heads % seq == 0;
+      falls back to ring when they don't divide."""
 
     num_heads: int
     head_dim: int
@@ -111,14 +118,20 @@ class CrossAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mesh: Optional[jax.sharding.Mesh] = None
     seq_parallel_min_seq: int = 4096
+    seq_parallel_mode: str = "ring"
+
+    def _seq_n(self) -> int:
+        from dcr_tpu.parallel.mesh import SEQ_AXIS
+
+        return dict(self.mesh.shape).get(SEQ_AXIS, 1) if self.mesh else 1
 
     def _ring_ok(self, b: int, sq: int, is_self: bool) -> bool:
         if not is_self or self.mesh is None:
             return False
-        from dcr_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS, SEQ_AXIS
+        from dcr_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
 
         shape = dict(self.mesh.shape)
-        n_seq = shape.get(SEQ_AXIS, 1)
+        n_seq = self._seq_n()
         n_batch = shape.get(DATA_AXIS, 1) * shape.get(FSDP_AXIS, 1)
         return (n_seq > 1 and sq >= self.seq_parallel_min_seq
                 and sq % n_seq == 0 and b % n_batch == 0)
@@ -137,9 +150,16 @@ class CrossAttention(nn.Module):
         k = k.reshape(b, sk, self.num_heads, self.head_dim)
         v = v.reshape(b, sk, self.num_heads, self.head_dim)
         if self._ring_ok(b, sq, is_self):
-            from dcr_tpu.ops.ring_attention import ring_self_attention
+            if (self.seq_parallel_mode == "ulysses"
+                    and self.num_heads % self._seq_n() == 0):
+                from dcr_tpu.ops.ulysses_attention import ulysses_self_attention
 
-            out = ring_self_attention(q, k, v, self.mesh)
+                out = ulysses_self_attention(q, k, v, self.mesh,
+                                             use_flash=self.use_flash)
+            else:
+                from dcr_tpu.ops.ring_attention import ring_self_attention
+
+                out = ring_self_attention(q, k, v, self.mesh)
         else:
             out = dot_product_attention(q, k, v, use_flash=self.use_flash)
         out = out.reshape(b, sq, inner)
@@ -174,6 +194,7 @@ class BasicTransformerBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mesh: Optional[jax.sharding.Mesh] = None
     seq_parallel_min_seq: int = 4096
+    seq_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -181,6 +202,7 @@ class BasicTransformerBlock(nn.Module):
                               use_flash=self.use_flash, dtype=self.dtype,
                               mesh=self.mesh,
                               seq_parallel_min_seq=self.seq_parallel_min_seq,
+                              seq_parallel_mode=self.seq_parallel_mode,
                               name="attn1")
         x = x + attn(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="norm1")(x))
         xattn = CrossAttention(self.num_heads, self.head_dim, self.dim,
@@ -208,6 +230,7 @@ class Transformer2D(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mesh: Optional[jax.sharding.Mesh] = None
     seq_parallel_min_seq: int = 4096
+    seq_parallel_mode: str = "ring"
 
     @nn.compact
     def __call__(self, x: jax.Array, context: jax.Array) -> jax.Array:
@@ -228,6 +251,7 @@ class Transformer2D(nn.Module):
                                         use_flash=self.use_flash, dtype=self.dtype,
                                         mesh=self.mesh,
                                         seq_parallel_min_seq=self.seq_parallel_min_seq,
+                                        seq_parallel_mode=self.seq_parallel_mode,
                                         name=f"blocks_{i}")(out, context)
         if self.use_linear_projection:
             out = nn.Dense(c, dtype=self.dtype, name="proj_out")(out)

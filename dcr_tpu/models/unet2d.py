@@ -55,7 +55,8 @@ class UNet2DCondition(nn.Module):
                 num_groups=groups, use_flash=cfg.flash_attention,
                 use_linear_projection=cfg.use_linear_projection, dtype=dtype,
                 mesh=self.mesh,
-                seq_parallel_min_seq=cfg.seq_parallel_min_seq, name=name)
+                seq_parallel_min_seq=cfg.seq_parallel_min_seq,
+                seq_parallel_mode=cfg.seq_parallel_mode, name=name)
 
         # --- time embedding
         t_emb = L.timestep_embedding(timesteps, block_out[0])

@@ -1,0 +1,78 @@
+"""Ulysses-style all-to-all sequence parallelism: exact attention over
+sequences sharded across chips, via head-scatter/sequence-gather.
+
+The framework's second sequence/context-parallel strategy, complementing
+ring attention (ops/ring_attention.py). Where ring keeps queries resident
+and rotates K/V shards around the `seq` axis in n-1 `ppermute` hops,
+Ulysses (DeepSpeed-Ulysses, Jacobs et al. 2023) re-shards ONCE each way:
+an `all_to_all` converts the layout from sequence-sharded [B, S/n, H, D]
+to head-sharded [B, S, H/n, D], each chip then runs ordinary full-sequence
+attention for its head group — which on TPU dispatches to the Pallas flash
+kernel through the standard `ops.attention` path, something ring's
+block-online-softmax structure cannot do — and a second `all_to_all`
+restores sequence sharding.
+
+Trade-off (the reason both strategies exist): Ulysses moves 4 activation-
+sized all-to-alls per attention (q,k,v in; out back) regardless of n and
+needs H % n == 0; ring moves 2(n-1) K/V-shard ppermutes that overlap with
+compute and has no head-count constraint, but computes attention in
+S/n-sized blocks. Short of measuring, Ulysses tends to win where per-chip
+flash over the full sequence beats blockwise XLA attention (big S, few
+chips); ring wins at large n or when heads don't divide.
+
+The reference has no analogue (its only attention-scaling measure is
+single-GPU xformers, diff_train.py:578 — SURVEY.md §5.7); both strategies
+exist to make long-context first-class on TPU meshes.
+
+Usage: wrap in shard_map over the seq axis (:func:`ulysses_self_attention`)
+or call :func:`ulysses_attention` inside an existing shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from dcr_tpu.ops.attention import dot_product_attention
+from dcr_tpu.parallel.mesh import SEQ_AXIS
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str = SEQ_AXIS,
+                      use_flash: bool = True) -> jax.Array:
+    """Exact attention with q/k/v re-sharded seq→heads over `axis_name`.
+
+    Call inside shard_map/pmap with q/k/v being the *local* sequence shards
+    [B, S_local, H, D]; H must divide by the axis size. Returns the local
+    output shard [B, S_local, H, D].
+    """
+    n = jax.lax.axis_size(axis_name)
+    if q.shape[2] % n:
+        raise ValueError(
+            f"ulysses needs heads {q.shape[2]} divisible by seq axis {n}"
+            " (use ring attention otherwise)")
+    # head-scatter / sequence-gather: [B, S/n, H, D] -> [B, S, H/n, D]
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            split_axis=2, concat_axis=1, tiled=True)
+    out = dot_product_attention(a2a(q), a2a(k), a2a(v), use_flash=use_flash)
+    # inverse: sequence-scatter / head-gather
+    return jax.lax.all_to_all(out, axis_name=axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+
+def ulysses_self_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           mesh: Mesh,
+                           batch_axes: tuple[str, ...] = ("data", "fsdp"),
+                           use_flash: bool = True) -> jax.Array:
+    """shard_map wrapper: q/k/v are GLOBAL [B, S, H, D] arrays; the sequence
+    axis is sharded over the mesh's `seq` axis, batch over the batch axes."""
+    spec = P(batch_axes, SEQ_AXIS, None, None)
+    fn = jax.shard_map(
+        functools.partial(ulysses_attention, axis_name=SEQ_AXIS,
+                          use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -158,7 +158,8 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
         prompts = build_prompt_list(
             modelstyle, cfg.num_batches, seed=cfg.seed, tokenizer=tokenizer,
             caption_json=caption_json,
-            rand_augs=cfg.rand_augs if cfg.rand_augs != "none" else None)
+            rand_augs=cfg.rand_augs if cfg.rand_augs != "none" else None,
+            rand_aug_repeats=cfg.rand_aug_repeats)
     savepath = Path(cfg.savepath or "inferences/run")
     gen_dir = savepath / "generations"
     if dist.is_primary():

@@ -1,0 +1,168 @@
+"""CLI surface tests: drive each L5 entry point's main() exactly as a user
+would (argv lists), asserting the filesystem contracts MIGRATION.md promises.
+The CLIs are the reference-script replacements (diff_train.py,
+diff_inference.py, diff_retrieval.py, sd_mitigation.py, embedding_search/*),
+so this is the migration contract under test."""
+
+import json
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from dcr_tpu.core.config import (DataConfig, ModelConfig, OptimConfig,
+                                 TrainConfig, save_config, to_dict)
+
+# every test here compiles real (tiny) models end-to-end: slow tier
+pytestmark = pytest.mark.slow
+
+
+def _images(dirpath, n, seed=0, size=20):
+    dirpath.mkdir(parents=True, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(n):
+        Image.fromarray(rng.integers(0, 255, (size, size, 3), np.uint8)).save(
+            dirpath / f"{i}.png")
+
+
+@pytest.fixture(scope="module")
+def cli_ckpt(tmp_path_factory):
+    """Tiny HF-layout checkpoint + run dir with config.json, as dcr-train
+    leaves it (module-scoped: sampling CLIs reuse it)."""
+    import jax
+
+    from dcr_tpu.core.checkpoint import export_hf_layout
+    from dcr_tpu.diffusion.trainer import build_models
+
+    tmp = tmp_path_factory.mktemp("cli_run")
+    cfg = TrainConfig()
+    cfg.model = ModelConfig.tiny()
+    cfg.data = DataConfig(class_prompt="classlevel")
+    models, params = build_models(cfg, jax.random.key(0))
+    export_hf_layout(
+        tmp / "checkpoint", unet=params["unet"], vae=params["vae"],
+        text_encoder=params["text"],
+        scheduler_config={"num_train_timesteps": 1000,
+                          "beta_schedule": "scaled_linear",
+                          "beta_start": 0.00085, "beta_end": 0.012,
+                          "prediction_type": "epsilon"},
+        model_config=to_dict(cfg.model))
+    (tmp / "config.json").write_text(json.dumps(to_dict(cfg)))
+    return tmp
+
+
+def test_cli_train_main(tmp_path, cpu_devices):
+    """dcr-train: --config file + dotted overrides -> checkpoints, config.json,
+    metrics (MIGRATION.md train table)."""
+    from dcr_tpu.cli import train as cli_train
+
+    _images(tmp_path / "data" / "c0", 8, seed=1)
+    _images(tmp_path / "data" / "c1", 8, seed=2)
+    cfg = TrainConfig(
+        output_dir=str(tmp_path / "run"), seed=0, train_batch_size=2,
+        max_train_steps=2, mixed_precision="no", save_steps=1000,
+        modelsavesteps=1000, log_every=1, model=ModelConfig.tiny(),
+        data=DataConfig(train_data_dir=str(tmp_path / "data"), resolution=16,
+                        class_prompt="nolevel", num_workers=2, seed=0),
+        optim=OptimConfig(learning_rate=1e-4, lr_scheduler="constant",
+                          lr_warmup_steps=0))
+    save_config(cfg, tmp_path / "cfg.json")
+    cli_train.main([f"--config={tmp_path / 'cfg.json'}",
+                    "--max_train_steps=2"])          # dotted override on top
+    run = tmp_path / "run"
+    assert (run / "config.json").exists()
+    assert (run / "checkpoint" / "unet" / "params.npz").exists()
+    lines = [json.loads(l) for l in
+             (run / "logs" / "metrics.jsonl").read_text().splitlines()]
+    assert any("loss" in l for l in lines)
+
+
+def test_cli_sample_main_with_modelstyle_override(cli_ckpt, tmp_path,
+                                                  cpu_devices):
+    """dcr-sample: --modelstyle override beats the config.json regime; PNGs +
+    prompts.txt contract (MIGRATION.md sample table)."""
+    from dcr_tpu.cli import sample as cli_sample
+
+    out = tmp_path / "inf"
+    cli_sample.main([f"--model_path={cli_ckpt}", f"--savepath={out}",
+                     "--num_batches=2", "--im_batch=1", "--resolution=16",
+                     "--num_inference_steps=2", "--sampler=ddim", "--seed=0",
+                     "--modelstyle=nolevel"])
+    gens = sorted((out / "generations").glob("*.png"))
+    assert len(gens) == 2
+    prompts = (out / "prompts.txt").read_text().splitlines()
+    # nolevel override: constant instance prompt, NOT classlevel from config
+    assert prompts and all(p == prompts[0] for p in prompts)
+    assert not prompts[0].startswith("An image of ")
+
+
+def test_cli_sample_modelstyle_from_config_json(cli_ckpt, tmp_path,
+                                                cpu_devices):
+    """Without --modelstyle the regime comes from the run's config.json
+    (classlevel here) — the reference's parse-the-path heuristic replacement."""
+    from dcr_tpu.cli import sample as cli_sample
+
+    out = tmp_path / "inf2"
+    cli_sample.main([f"--model_path={cli_ckpt}", f"--savepath={out}",
+                     "--num_batches=2", "--im_batch=1", "--resolution=16",
+                     "--num_inference_steps=2", "--sampler=ddim", "--seed=0"])
+    prompts = (out / "prompts.txt").read_text().splitlines()
+    assert all(p.startswith("An image of ") for p in prompts)
+
+
+def test_cli_mitigate_main(cli_ckpt, tmp_path, cpu_devices, monkeypatch):
+    """dcr-mitigate: 12 known-replication prompts, savepath suffix encodes the
+    mitigation, augmentation changes the prompts (MIGRATION.md mitigation)."""
+    from dcr_tpu.cli import mitigate as cli_mitigate
+
+    monkeypatch.chdir(tmp_path)
+    cli_mitigate.main([f"--model_path={cli_ckpt}", "--im_batch=1",
+                       "--resolution=16", "--num_inference_steps=2",
+                       "--sampler=ddim", "--seed=2",
+                       "--rand_augs=rand_word_add"])
+    out = tmp_path / "inferences" / "mitigation_aug_rand_word_add"
+    gens = sorted((out / "generations").glob("*.png"))
+    assert len(gens) == len(cli_mitigate.KNOWN_REPLICATION_PROMPTS)
+    prompts = (out / "prompts.txt").read_text().splitlines()
+    assert len(prompts) == 12
+    # each augmented prompt contains its original's words plus an insertion
+    assert prompts != list(cli_mitigate.KNOWN_REPLICATION_PROMPTS)
+
+
+def test_cli_evaluate_main(tmp_path, cpu_devices):
+    """dcr-eval: similarity stats over query/values dirs land in
+    similarityscores + scalars (MIGRATION.md evaluate table). Random-init
+    backbone; heavy metrics off."""
+    from dcr_tpu.cli import evaluate as cli_evaluate
+
+    _images(tmp_path / "query" / "generations", 3, seed=3)
+    (tmp_path / "query" / "prompts.txt").write_text("a\nb\nc\n")
+    _images(tmp_path / "values" / "c0", 4, seed=4)
+    cli_evaluate.main([
+        f"--query_dir={tmp_path / 'query' / 'generations'}",
+        f"--values_dir={tmp_path / 'values'}",
+        "--pt_style=sscd", "--arch=resnet50_disc", "--batch_size=2",
+        "--image_size=32", "--compute_fid=false",
+        "--compute_clip_score=false", "--compute_complexity=true",
+        "--galleries=false", f"--output_dir={tmp_path / 'plots'}"])
+    assert (tmp_path / "plots").exists()
+
+
+def test_cli_search_embed_and_search(tmp_path, cpu_devices):
+    """dcr-search embed + search: embedding dumps, chunked top-1 merge, result
+    file (MIGRATION.md search table)."""
+    from dcr_tpu.cli import search as cli_search
+
+    _images(tmp_path / "gens", 3, seed=5)
+    _images(tmp_path / "laion" / "chunk0", 4, seed=6)
+    cli_search.main(["embed", f"--gen_folder={tmp_path / 'gens'}",
+                     "--image_size=32", "--batch_size=2"])
+    cli_search.main(["embed", f"--gen_folder={tmp_path / 'laion' / 'chunk0'}",
+                     "--image_size=32", "--batch_size=2"])
+    assert (tmp_path / "gens" / "embedding.npz").exists()
+    out = tmp_path / "result.npz"
+    cli_search.main(["search", f"--gen_folder={tmp_path / 'gens'}",
+                     f"--laion_folder={tmp_path / 'laion'}",
+                     f"--out_path={out}"])
+    res = np.load(out, allow_pickle=True)
+    assert len(res["scores"]) == 3

@@ -1,0 +1,37 @@
+#!/bin/bash
+# One-window measurement bank: the tunneled TPU backend has been available
+# only intermittently (down for the whole round-3 driver window), so when it
+# IS up, capture every number the evidence chain needs in one pass:
+#
+#   1. bench.py            — 256px ladder + bs32/remat + 512px flash pair
+#   2. tools/sweep_flash.py      — isolated-kernel table (SWEEP_FLASH.jsonl)
+#   3. tools/crosscheck_timing.py — independent scan-chain corroboration
+#   4. tools/bench_sample.py     — config-3 sampling throughput
+#
+# Each stage gets its own timeout so a mid-run wedge can't eat the window.
+# The bench progress trail is snapshotted to BENCH_PROGRESS_r${ROUND}${TAG}.json
+# for committing (the raw artifact BASELINE.md cites).
+#
+# Usage: ROUND=4 TAG=a bash tools/measure_all.sh
+set -u
+cd "$(dirname "$0")/.."
+ROUND="${ROUND:-4}"
+TAG="${TAG:-a}"
+LOG="measure_all_r${ROUND}${TAG}.log"
+
+run() { # name timeout_s cmd...
+  local name="$1" t="$2"; shift 2
+  echo "=== $name (timeout ${t}s) $(date +%H:%M:%S) ===" | tee -a "$LOG"
+  timeout "$t" "$@" >> "$LOG" 2>&1
+  echo "=== $name rc=$? $(date +%H:%M:%S) ===" | tee -a "$LOG"
+}
+
+run bench     5400 python bench.py
+cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
+run sweep     2400 python tools/sweep_flash.py
+run crosscheck 1800 python tools/crosscheck_timing.py
+run sample    1800 python tools/bench_sample.py
+
+echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"
+echo "commit the snapshot + SWEEP_FLASH.jsonl + CROSSCHECK_TIMING.jsonl +"
+echo "BENCH_SAMPLE.jsonl and update BASELINE.md from them."
