@@ -225,7 +225,11 @@ class Watchdog:
     re-exec) runs instead of a plain abort. BENCH_TIMEOUT_SECS<=0 disables."""
 
     def __init__(self) -> None:
-        self.timeout = _env_float("BENCH_TIMEOUT_SECS", 2400.0)
+        # must fire comfortably inside the driver's observed ~30min kill
+        # window even when armed mid-run, or a post-init hang dies with no
+        # emission (the round-3 rc=124 shape); longest healthy phase is a
+        # cold 512px remote-compile (~7min), so 900s clears it 2x over
+        self.timeout = _env_float("BENCH_TIMEOUT_SECS", 900.0)
         self.deadline = [time.monotonic() + self.timeout]
         self.armed_secs = [self.timeout]
         self.action = [None]
@@ -552,7 +556,10 @@ def bench_512(jax, dog: Watchdog, t_start: float, budget: float) -> dict | None:
 def main() -> None:
     os.environ.setdefault("BENCH_T0", str(time.time()))
     t_start = float(os.environ["BENCH_T0"])
-    budget = _env_float("BENCH_TIME_BUDGET_SECS", 6000.0)
+    # stop STARTING rungs well before the driver's ~30min kill so the banked
+    # best is emitted by us, not lost to SIGKILL (budget is checked between
+    # rungs; BENCH_T0 rides through re-execs so retries count against it)
+    budget = _env_float("BENCH_TIME_BUDGET_SECS", 1500.0)
     mark("start", argv=sys.argv, bs_env=os.environ.get("BENCH_BS"),
          attempt=int(os.environ.get("BENCH_BACKEND_ATTEMPT", "0")))
     dog = Watchdog()

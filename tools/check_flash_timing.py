@@ -7,15 +7,16 @@ wrong (block_until_ready was a no-op on this backend), so this tool times the
 same ops with an INDEPENDENT second method and reports both:
 
 - slope:  N un-chained dispatches, one host fetch, slope over N.
-- scan:   a single jitted lax.scan of length N whose carry chains each
-          attention output into the next call's query — XLA cannot overlap or
-          elide iterations, the whole chain is one dispatch, and the wall time
-          of fetching the final carry divided by N bounds per-op time from
-          above (includes scan overhead, so scan >= truth >= slope modulo
-          dispatch pipelining).
+- scan:   jitted lax.scan chains whose carry feeds each attention output into
+          the next call's query — XLA cannot overlap or elide iterations and
+          each chain is ONE dispatch. Per-call time is the two-length delta
+          (T(N_hi) - T(N_lo)) / (N_hi - N_lo), which cancels dispatch, RTT,
+          and scan-entry constants exactly (no separately-measured RTT to
+          subtract).
 
+Both methods run fwd and fwd+bwd (the sweep table has both columns).
 Agreement within ~10% validates the sweep table. Appends one JSON object per
-(shape, impl) to CHECK_FLASH_TIMING.jsonl.
+(shape, impl, direction) to CHECK_FLASH_TIMING.jsonl.
 
 Usage: python tools/check_flash_timing.py   (on a box where jax sees the TPU)
 """
@@ -43,7 +44,7 @@ SHAPES = [  # (B, H, S, D)
     (4, 10, 4096, 64),
     (1, 5, 16384, 64),
 ]
-SCAN_LEN = 20
+SCAN_LO, SCAN_HI = 2, 20
 
 
 def emit(rec: dict) -> None:
@@ -75,29 +76,30 @@ def time_slope(fn, *args, iters: int = 20) -> float:
     return max(tn - t1, 0.0) / iters * 1e3
 
 
-def time_scan(fn, q, k, v, length: int = SCAN_LEN) -> float:
-    """ms/iter, method 2: one dispatch of a length-N chained scan."""
+def time_scan(fn, q, k, v) -> float:
+    """ms/iter, method 2: two chained-scan dispatches, per-call from the
+    length delta (cancels dispatch/RTT/scan-entry constants exactly)."""
 
-    @jax.jit
-    def chained(q0):
-        def body(carry, _):
-            # carry feeds the next query: a real data dependency every step
-            return fn(carry, k, v).astype(carry.dtype), None
+    def chained_time(length: int) -> float:
+        @jax.jit
+        def chained(q0):
+            def body(carry, _):
+                # carry feeds the next query: a real data dependency per step
+                return fn(carry, k, v).astype(carry.dtype), None
 
-        out, _ = jax.lax.scan(body, q0, None, length=length)
-        return out
+            out, _ = jax.lax.scan(body, q0, None, length=length)
+            return out
 
-    _sync(chained(q))                       # compile + warmup
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        _sync(chained(q))
-        times.append(time.perf_counter() - t0)
-    # subtract one measured round-trip (a trivial fetch) from the wall time
-    t0 = time.perf_counter()
-    _sync(jnp.zeros((1,)))
-    rtt = time.perf_counter() - t0
-    return max(min(times) - rtt, 0.0) / length * 1e3
+        _sync(chained(q))                   # compile + warmup
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            _sync(chained(q))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo, t_hi = chained_time(SCAN_LO), chained_time(SCAN_HI)
+    return max(t_hi - t_lo, 0.0) / (SCAN_HI - SCAN_LO) * 1e3
 
 
 def main() -> None:
@@ -110,21 +112,42 @@ def main() -> None:
         q = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
+        def flash_fwd(q, k, v):
+            return fa.flash_attention(q, k, v)
+
+        def xla_fwd(q, k, v):
+            return jax.nn.dot_product_attention(q, k, v)
+
+        def grad_of(op):
+            def loss(qq, kk, vv):
+                return jnp.sum(op(qq, kk, vv).astype(jnp.float32) ** 2)
+
+            g = jax.grad(loss)               # dq only: carry-compatible
+
+            def fwd_bwd(qq, kk, vv):
+                return g(qq, kk, vv)
+
+            return fwd_bwd
+
         impls = {
-            "flash": jax.jit(functools.partial(fa.flash_attention)),
-            "xla": jax.jit(lambda q, k, v: jax.nn.dot_product_attention(q, k, v)),
+            ("flash", "fwd"): jax.jit(flash_fwd),
+            ("xla", "fwd"): jax.jit(xla_fwd),
+            ("flash", "fwd_bwd"): jax.jit(grad_of(flash_fwd)),
+            ("xla", "fwd_bwd"): jax.jit(grad_of(xla_fwd)),
         }
-        for name, fn in impls.items():
+        for (name, what), fn in impls.items():
             try:
                 slope_ms = time_slope(fn, q, k, v)
                 scan_ms = time_scan(fn, q, k, v)
                 ratio = scan_ms / slope_ms if slope_ms > 0 else float("inf")
-                emit({"phase": "timing", "impl": name, "b": b, "h": h, "s": s,
-                      "d": d, "slope_ms": round(slope_ms, 3),
-                      "scan_ms": round(scan_ms, 3), "scan_over_slope": round(ratio, 3)})
+                emit({"phase": "timing", "impl": name, "what": what,
+                      "b": b, "h": h, "s": s, "d": d,
+                      "slope_ms": round(slope_ms, 3),
+                      "scan_ms": round(scan_ms, 3),
+                      "scan_over_slope": round(ratio, 3)})
             except Exception as e:
-                emit({"phase": "error", "impl": name, "b": b, "h": h, "s": s,
-                      "error": repr(e)[:300]})
+                emit({"phase": "error", "impl": name, "what": what,
+                      "b": b, "h": h, "s": s, "error": repr(e)[:300]})
 
 
 if __name__ == "__main__":

@@ -5,7 +5,7 @@
 #
 #   1. bench.py            — 256px ladder + bs32/remat + 512px flash pair
 #   2. tools/sweep_flash.py      — isolated-kernel table (SWEEP_FLASH.jsonl)
-#   3. tools/crosscheck_timing.py — independent scan-chain corroboration
+#   3. tools/check_flash_timing.py — independent scan-chain corroboration
 #   4. tools/bench_sample.py     — config-3 sampling throughput
 #
 # Each stage gets its own timeout so a mid-run wedge can't eat the window.
@@ -26,12 +26,14 @@ run() { # name timeout_s cmd...
   echo "=== $name rc=$? $(date +%H:%M:%S) ===" | tee -a "$LOG"
 }
 
-run bench     5400 python bench.py
+# manual window: no driver kill looming, so give the ladder its full room
+# (the in-repo defaults are sized for the driver's ~30min window)
+run bench     5400 env BENCH_TIME_BUDGET_SECS=4800 BENCH_TIMEOUT_SECS=2400 python bench.py
 cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
 run sweep     2400 python tools/sweep_flash.py
-run crosscheck 1800 python tools/crosscheck_timing.py
+run crosscheck 1800 python tools/check_flash_timing.py
 run sample    1800 python tools/bench_sample.py
 
 echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"
-echo "commit the snapshot + SWEEP_FLASH.jsonl + CROSSCHECK_TIMING.jsonl +"
+echo "commit the snapshot + SWEEP_FLASH.jsonl + CHECK_FLASH_TIMING.jsonl +"
 echo "BENCH_SAMPLE.jsonl and update BASELINE.md from them."
