@@ -39,8 +39,52 @@ print(f"RANK{rank}_OK")
 """
 
 
-@pytest.mark.slow
-def test_two_process_dcn(tmp_path):
+WORKER_SEQ_PARALLEL = r"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.config import MeshConfig
+from dcr_tpu.ops.attention import dot_product_attention
+from dcr_tpu.ops.ring_attention import ring_self_attention
+from dcr_tpu.ops.ulysses_attention import ulysses_self_attention
+from dcr_tpu.parallel import make_mesh, to_host
+
+dist.initialize()
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 2, jax.local_device_count()
+# seq axis of 4 spans both processes: ring's ppermute hops and ulysses'
+# all_to_all both cross the process (DCN) boundary
+mesh = make_mesh(MeshConfig(data=1, fsdp=1, tensor=1, seq=4))
+
+rng = np.random.default_rng(0)          # same arrays on both processes
+b, s, h, d = 2, 64, 4, 8
+full = {n: rng.standard_normal((b, s, h, d)).astype(np.float32)
+        for n in ("q", "k", "v")}
+sharding = NamedSharding(mesh, P(None, "seq", None, None))
+glob = {n: jax.make_array_from_callback(
+            (b, s, h, d), sharding, lambda idx, n=n: full[n][idx])
+        for n in full}
+
+ref = np.asarray(dot_product_attention(      # process-local dense reference
+    jnp.asarray(full["q"]), jnp.asarray(full["k"]), jnp.asarray(full["v"]),
+    use_flash=False))
+for name, fn in (("ring", ring_self_attention),
+                 ("ulysses", ulysses_self_attention)):
+    out = to_host(fn(glob["q"], glob["k"], glob["v"], mesh))
+    err = float(np.max(np.abs(np.asarray(out) - ref)))
+    assert err < 2e-5, (name, err)
+print(f"RANK{dist.process_index()}_SP_OK")
+"""
+
+
+def _run_two_process(worker_src: str, ok_token: str, *, local_devices: int = 1,
+                     timeout: int = 240) -> None:
     port = socket.socket()
     port.bind(("127.0.0.1", 0))
     addr = f"127.0.0.1:{port.getsockname()[1]}"
@@ -56,13 +100,16 @@ def test_two_process_dcn(tmp_path):
             "PATH": "/usr/bin:/bin:/usr/local/bin",
             "HOME": "/tmp",
         }
-        procs.append(subprocess.Popen([sys.executable, "-c", WORKER], env=env,
-                                      stdout=subprocess.PIPE,
+        if local_devices > 1:
+            env["XLA_FLAGS"] = (
+                f"--xla_force_host_platform_device_count={local_devices}")
+        procs.append(subprocess.Popen([sys.executable, "-c", worker_src],
+                                      env=env, stdout=subprocess.PIPE,
                                       stderr=subprocess.STDOUT, text=True))
     outs = []
     for p in procs:
         try:
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -70,4 +117,17 @@ def test_two_process_dcn(tmp_path):
         outs.append(out)
     for rank, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
-        assert f"RANK{rank}_OK" in out
+        assert ok_token.format(rank=rank) in out
+
+
+@pytest.mark.slow
+def test_two_process_dcn():
+    _run_two_process(WORKER, "RANK{rank}_OK")
+
+
+@pytest.mark.slow
+def test_two_process_seq_parallel_attention():
+    """Ring ppermute + Ulysses all_to_all across a seq axis spanning two
+    processes (collectives over the DCN boundary), exact vs dense."""
+    _run_two_process(WORKER_SEQ_PARALLEL, "RANK{rank}_SP_OK",
+                     local_devices=2, timeout=360)

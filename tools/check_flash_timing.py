@@ -23,7 +23,6 @@ Usage: python tools/check_flash_timing.py   (on a box where jax sees the TPU)
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
@@ -105,7 +104,9 @@ def time_scan(fn, q, k, v) -> float:
 def main() -> None:
     from dcr_tpu.ops import flash_attention as fa
 
-    emit({"phase": "devices", "devices": [str(d) for d in jax.devices()]})
+    interpret = jax.devices()[0].platform == "cpu"   # Pallas interpreter off-TPU
+    emit({"phase": "devices", "devices": [str(d) for d in jax.devices()],
+          "interpret": interpret})
     rng = np.random.default_rng(0)
 
     for (b, h, s, d) in SHAPES:
@@ -113,7 +114,9 @@ def main() -> None:
         k = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         v = jnp.asarray(rng.standard_normal((b, s, h, d)), jnp.bfloat16)
         def flash_fwd(q, k, v):
-            return fa.flash_attention(q, k, v)
+            # clamp blocks to the sequence like the kernel's own defaults
+            return fa.flash_attention(q, k, v, interpret,
+                                      min(1024, s), min(1024, s))
 
         def xla_fwd(q, k, v):
             return jax.nn.dot_product_attention(q, k, v)
