@@ -204,10 +204,10 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
     sim = SIM.similarity_matrix(values_feats, query_feats,
                                 metric=cfg.similarity_metric,
                                 num_chunks=cfg.num_loss_chunks,
-                                chunk_style=cfg.chunk_style)
+                                chunk_style=cfg.chunk_style, mesh=mesh)
     stats = SIM.gen_train_stats(sim)
     scalars: dict = stats.scalars()
-    bg = SIM.train_train_background(values_feats)
+    bg = SIM.train_train_background(values_feats, mesh=mesh)
     scalars.update(SIM.background_stats(bg))
     if dist.is_primary():
         out_dir.mkdir(parents=True, exist_ok=True)

@@ -11,42 +11,73 @@ The metrics engine of the reference (diff_retrieval.py:391-483):
   (454-468)
 - train↔train background: top-2 minus self (418-419)
 
-On TPU the matmul runs jitted (sharded when the mesh has multiple chips) —
-the rank-0-only einsum-chunking workaround disappears (SURVEY.md §3.5), though
-query chunking is kept for N×M that exceed memory.
+On TPU the matmul runs jitted; pass ``mesh`` to shard it — query rows
+spread over every mesh device, values replicated, each chip computing its
+row-slab — so the reference's rank-0-only einsum-chunking workaround
+disappears (SURVEY.md §3.5). Query chunking is kept for N×M that exceed
+memory even sharded.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcr_tpu.parallel.mesh import to_host
 
 
 def l2_normalize(x: np.ndarray, axis: int = -1, eps: float = 1e-12) -> np.ndarray:
     return x / np.maximum(np.linalg.norm(x, axis=axis, keepdims=True), eps)
 
 
+def _row_sharded(f, mesh: Mesh, n_row_args: int = 1):
+    """jit f(*row_args, v) with the leading args' rows spread across EVERY
+    device of the mesh and v replicated — each chip computes its slab of the
+    [N_query, N_train] matrix."""
+    rows = NamedSharding(mesh, P(tuple(mesh.axis_names)))
+    rep = NamedSharding(mesh, P())
+    jf = jax.jit(f, in_shardings=(rows,) * n_row_args + (rep,),
+                 out_shardings=rows)
+    n_dev = mesh.size
+
+    def call(*args):
+        row_args, v = args[:-1], args[-1]
+        n = row_args[0].shape[0]
+        pad = (-n) % n_dev              # row sharding needs divisibility
+        if pad:
+            row_args = tuple(
+                jnp.concatenate([a, jnp.zeros((pad, *a.shape[1:]), a.dtype)])
+                for a in row_args)
+        out = jf(*row_args, v)
+        return out[:n] if pad else out
+
+    return call
+
+
 def similarity_matrix(values: np.ndarray, query: np.ndarray, *,
                       metric: str = "dotproduct", num_chunks: int = 1,
-                      chunk_style: str = "max",
-                      block_size: int = 8192) -> np.ndarray:
+                      chunk_style: str = "max", block_size: int = 8192,
+                      mesh: Optional[Mesh] = None) -> np.ndarray:
     """sim [N_query, N_train] (note: transposed vs the reference's internal
     [values, query] layout; this is the simscores orientation it analyzes)."""
     values = jnp.asarray(values)
     query = jnp.asarray(query)
 
     if metric == "dotproduct":
-        f = jax.jit(lambda q, v: q @ v.T)
+        def f(q, v):
+            return q @ v.T
     elif metric == "splitloss":
         n, d = values.shape
         if d % num_chunks:
             raise ValueError(f"feature dim {d} not divisible by {num_chunks} chunks")
         p = d // num_chunks
 
-        def split_sim(q, v):
+        def f(q, v):
             qc = q.reshape(q.shape[0], num_chunks, p)
             vc = v.reshape(v.shape[0], num_chunks, p)
             if chunk_style == "cross":
@@ -61,15 +92,17 @@ def similarity_matrix(values: np.ndarray, query: np.ndarray, *,
                 return jnp.mean(chunk_dp, axis=-1)
             raise ValueError(f"unknown chunk_style {chunk_style!r} "
                              "(max | mean | cross)")
-
-        f = jax.jit(split_sim)
     else:
         raise ValueError(f"unknown similarity metric {metric!r}")
 
+    call = _row_sharded(f, mesh) if (mesh is not None and mesh.size > 1) \
+        else jax.jit(f)
+
     blocks = []
     for start in range(0, query.shape[0], block_size):
-        blocks.append(np.asarray(jax.device_get(f(query[start:start + block_size],
-                                                  values))))
+        # to_host, not device_get: on a multi-host mesh the row-sharded output
+        # spans non-addressable devices and needs the process allgather
+        blocks.append(to_host(call(query[start:start + block_size], values)))
     return np.concatenate(blocks, axis=0)
 
 
@@ -107,25 +140,28 @@ def gen_train_stats(sim: np.ndarray, threshold: float = 0.5) -> SimilarityStats:
     )
 
 
-def train_train_background(values: np.ndarray, *, block_size: int = 8192
-                           ) -> np.ndarray:
+def train_train_background(values: np.ndarray, *, block_size: int = 8192,
+                           mesh: Optional[Mesh] = None) -> np.ndarray:
     """[N_train] top-1 similarity of each training image to the *rest* of the
     training set (the reference's top-2-minus-self, diff_retrieval.py:418-419)."""
     values_j = jnp.asarray(values)
 
-    @jax.jit
-    def block_top2(q, offset):
-        sim = q @ values_j.T
-        # mask self-similarity by index
-        n = q.shape[0]
-        rows = jnp.arange(n) + offset
-        sim = sim.at[jnp.arange(n), rows].set(-jnp.inf)
+    def block_top2(q, rows, v):
+        sim = q @ v.T
+        # mask self-similarity by global row index (rows ride alongside q as
+        # a row-sharded operand; padded rows mask an arbitrary clamped index,
+        # harmless because they're trimmed)
+        sim = sim.at[jnp.arange(q.shape[0]), rows].set(-jnp.inf)
         return jnp.max(sim, axis=1)
+
+    call = (_row_sharded(block_top2, mesh, n_row_args=2)
+            if mesh is not None and mesh.size > 1 else jax.jit(block_top2))
 
     out = []
     for start in range(0, values.shape[0], block_size):
         q = values_j[start:start + block_size]
-        out.append(np.asarray(jax.device_get(block_top2(q, start))))
+        rows = jnp.arange(start, start + q.shape[0], dtype=jnp.int32)
+        out.append(to_host(call(q, rows, values_j)))
     return np.concatenate(out)
 
 

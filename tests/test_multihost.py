@@ -83,6 +83,37 @@ print(f"RANK{dist.process_index()}_SP_OK")
 """
 
 
+WORKER_SHARDED_SIMILARITY = r"""
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.config import MeshConfig
+from dcr_tpu.eval import similarity as SIM
+from dcr_tpu.parallel import make_mesh
+
+dist.initialize()
+assert jax.process_count() == 2, jax.process_count()
+mesh = make_mesh(MeshConfig(data=4))    # 2 procs x 2 local = 4 global devices
+rng = np.random.default_rng(0)
+v = SIM.l2_normalize(rng.standard_normal((20, 16)).astype(np.float32))
+q = SIM.l2_normalize(rng.standard_normal((13, 16)).astype(np.float32))
+# row-sharded matmul spans both processes; outputs come back via the
+# process allgather (device_get would raise on non-addressable shards)
+sim = SIM.similarity_matrix(v, q, mesh=mesh)
+bg = SIM.train_train_background(v, mesh=mesh)
+ref = q @ v.T
+full = v @ v.T
+np.fill_diagonal(full, -np.inf)
+assert np.allclose(sim, ref, atol=1e-5)
+assert np.allclose(bg, full.max(axis=1), atol=1e-5)
+print(f"RANK{dist.process_index()}_SIM_OK")
+"""
+
+
 def _run_two_process(worker_src: str, ok_token: str, *, local_devices: int = 1,
                      timeout: int = 240) -> None:
     port = socket.socket()
@@ -130,4 +161,13 @@ def test_two_process_seq_parallel_attention():
     """Ring ppermute + Ulysses all_to_all across a seq axis spanning two
     processes (collectives over the DCN boundary), exact vs dense."""
     _run_two_process(WORKER_SEQ_PARALLEL, "RANK{rank}_SP_OK",
+                     local_devices=2, timeout=360)
+
+
+@pytest.mark.slow
+def test_two_process_sharded_similarity():
+    """Mesh-sharded eval similarity with the mesh spanning two processes —
+    the multi-host regime SURVEY §3.5's design targets; guards the
+    to_host-not-device_get output fetch."""
+    _run_two_process(WORKER_SHARDED_SIMILARITY, "RANK{rank}_SIM_OK",
                      local_devices=2, timeout=360)
