@@ -197,6 +197,9 @@ class OptimConfig:
     lr_warmup_steps: int = 5000
     gradient_accumulation_steps: int = 1
     scale_lr: bool = False
+    # 8-bit blockwise moment state (reference --use_8bit_adam via CUDA-only
+    # bitsandbytes, diff_train.py:424-435; TPU-native core/adam8bit.py)
+    use_8bit_adam: bool = False
 
 
 @dataclass

@@ -102,15 +102,18 @@ def make_lr_schedule(cfg: OptimConfig) -> optax.Schedule:
 
 def make_optimizer(cfg: OptimConfig) -> optax.GradientTransformation:
     """AdamW with global-norm clipping and optional scan-free grad accumulation
-    (reference: AdamW diff_train.py:424-446, clip 657-663, accumulate 618)."""
-    tx = optax.chain(
-        optax.clip_by_global_norm(cfg.max_grad_norm),
-        optax.adamw(
-            learning_rate=make_lr_schedule(cfg),
-            b1=cfg.adam_beta1, b2=cfg.adam_beta2,
-            eps=cfg.adam_epsilon, weight_decay=cfg.adam_weight_decay,
-        ),
+    (reference: AdamW diff_train.py:424-446, clip 657-663, accumulate 618;
+    --use_8bit_adam -> blockwise 8-bit moment state, core/adam8bit.py)."""
+    if cfg.use_8bit_adam:
+        from dcr_tpu.core.adam8bit import adamw8bit as adam_factory
+    else:
+        adam_factory = optax.adamw
+    adam = adam_factory(
+        learning_rate=make_lr_schedule(cfg),
+        b1=cfg.adam_beta1, b2=cfg.adam_beta2,
+        eps=cfg.adam_epsilon, weight_decay=cfg.adam_weight_decay,
     )
+    tx = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm), adam)
     if cfg.gradient_accumulation_steps > 1:
         tx = optax.MultiSteps(tx, cfg.gradient_accumulation_steps)
     return tx
