@@ -112,10 +112,17 @@ def scale_by_adam8(b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
     compose with weight decay and lr scaling like optax.scale_by_adam)."""
 
     def init(params):
+        def zero_q8(size: int, dtype) -> Quant8:
+            # all-zero codes directly: quantizing a zeros array would
+            # allocate a transient f32 buffer per leaf for the same result
+            n_blocks = -(-size // BLOCK)
+            return Quant8(jnp.zeros((n_blocks, BLOCK), dtype),
+                          jnp.zeros((n_blocks, 1), jnp.float32))
+
         def leaf(p):
             if _quantized_leaf(p, min_quantize_size):
-                return _Moments8(m=quantize_linear(jnp.zeros(p.shape)),
-                                 v=quantize_log(jnp.zeros(p.shape)))
+                return _Moments8(m=zero_q8(p.size, jnp.int8),
+                                 v=zero_q8(p.size, jnp.uint8))
             return {"m": jnp.zeros_like(p, jnp.float32),
                     "v": jnp.zeros_like(p, jnp.float32)}
 

@@ -11,9 +11,12 @@ import pytest
 
 from dcr_tpu.core import adam8bit as A8
 
-pytestmark = pytest.mark.fast
+# unit tests are fast-tier; the full-train-step integration test traces the
+# whole tiny model (~50s on one core) and lives in the slow tier
+fast = pytest.mark.fast
 
 
+@fast
 def test_linear_roundtrip_bound(rng_np):
     x = jnp.asarray(rng_np.standard_normal(10_000).astype(np.float32)) * 3.0
     t = A8.quantize_linear(x)
@@ -28,6 +31,7 @@ def test_linear_roundtrip_bound(rng_np):
     assert (err <= np.repeat(bound, A8.BLOCK, 1).reshape(-1)[:x.size] + 1e-7).all()
 
 
+@fast
 def test_log_roundtrip_relative_error(rng_np):
     # 6 decades of magnitude in one tensor: the regime where linear int8
     # fails and the log code must hold ~3% relative error
@@ -44,6 +48,7 @@ def test_log_roundtrip_relative_error(rng_np):
     assert float(jnp.max(A8.dequantize_log(z, (512,), 512))) == 0.0
 
 
+@fast
 def test_spike_block_zero_grad_does_not_diverge():
     """Regression: one coordinate's v dwarfed by a spike elsewhere in its
     block must NOT quantize to the exact-zero code — a later zero-gradient
@@ -64,6 +69,7 @@ def test_spike_block_zero_grad_does_not_diverge():
     assert abs(float(u8[1])) < 10 * abs(float(uref[1])) + 1e-3, float(u8[1])
 
 
+@fast
 def test_state_is_8bit_and_small(rng_np):
     params = {"w": jnp.asarray(rng_np.standard_normal((128, 128)), jnp.float32),
               "b": jnp.zeros((16,))}
@@ -78,6 +84,7 @@ def test_state_is_8bit_and_small(rng_np):
     assert w_bytes < 0.3 * (2 * 4 * 128 * 128)   # vs two f32 moments
 
 
+@fast
 def test_tracks_exact_adamw_on_quadratic(rng_np):
     """200 steps on a least-squares problem: the 8-bit trajectory must reach
     within 2x of exact adamw's final loss (and both must crush the start)."""
@@ -88,18 +95,21 @@ def test_tracks_exact_adamw_on_quadratic(rng_np):
         return jnp.mean((A @ w - y) ** 2)
 
     def run(tx):
-        w = jnp.zeros((4096,))
-        state = tx.init(w)
+        w0 = jnp.zeros((4096,))
+        state0 = tx.init(w0)
 
         @jax.jit
-        def step(w, state):
-            g = jax.grad(loss)(w)
-            updates, state = tx.update(g, state, w)
-            return optax.apply_updates(w, updates), state
+        def many(w, state):
+            def body(carry, _):
+                w, state = carry
+                g = jax.grad(loss)(w)
+                updates, state = tx.update(g, state, w)
+                return (optax.apply_updates(w, updates), state), ()
 
-        for _ in range(200):
-            w, state = step(w, state)
-        return float(loss(w))
+            (w, state), _ = jax.lax.scan(body, (w, state), None, length=200)
+            return w
+
+        return float(loss(many(w0, state0)))
 
     l8 = run(A8.adamw8bit(1e-2, weight_decay=0.0))
     lref = run(optax.adamw(1e-2, weight_decay=0.0))
@@ -108,6 +118,7 @@ def test_tracks_exact_adamw_on_quadratic(rng_np):
     assert l8 < max(2.0 * lref, lref + 1e-4)
 
 
+@pytest.mark.slow
 def test_train_step_with_8bit_adam(cpu_devices):
     """Full tiny train step with use_8bit_adam: loss finite, opt state holds
     int8 moment codes for the big leaves."""
