@@ -259,6 +259,10 @@ class EvalConfig:
     values_dir: str = ""                   # train data
     pt_style: str = "sscd"                 # "sscd" | "dino" | "clip"
     arch: str = "resnet50_disc"
+    # DINO ViT only: >1 takes the CLS feature of the layer-th-from-last
+    # block, get_intermediate_layers semantics (reference --layer,
+    # utils_ret.py:731-745)
+    layer: int = 1
     similarity_metric: str = "dotproduct"  # "dotproduct" | "splitloss"
     batch_size: int = 64
     image_size: int = 224

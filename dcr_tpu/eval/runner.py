@@ -96,11 +96,16 @@ def _validate_backbone(model, params: dict, image_size: int) -> None:
 
 
 def build_backbone(pt_style: str, arch: str, key: jax.Array,
-                   params: Optional[dict] = None, image_size: int = 224):
+                   params: Optional[dict] = None, image_size: int = 224,
+                   layer: int = 1):
     """(apply_fn, params) for the copy-detection embedder
     (reference model zoo switch, diff_retrieval.py:249-285). Random init unless
     converted pretrained params are supplied (models/convert.py or
-    load_backbone_params); supplied params are shape-validated."""
+    load_backbone_params); supplied params are shape-validated.
+
+    layer > 1 (DINO ViTs only): CLS feature of the layer-th-from-last block —
+    get_intermediate_layers(x, layer)[0][:, 0] semantics (reference --layer,
+    utils_ret.py:731-745)."""
     import jax.numpy as jnp
 
     if pt_style == "sscd":
@@ -113,11 +118,26 @@ def build_backbone(pt_style: str, arch: str, key: jax.Array,
         model = CLIPImageTower()
     else:
         raise ValueError(f"unknown pt_style {pt_style!r} (sscd | dino | clip)")
+    if layer > 1:
+        from dcr_tpu.models.vit import VisionTransformer
+
+        if pt_style != "dino" or not isinstance(model, VisionTransformer):
+            raise ValueError(
+                f"layer={layer} needs a DINO ViT arch (the reference path, "
+                "utils_ret.py:731, is get_intermediate_layers on the ViT; "
+                f"{pt_style}/{arch} has no intermediate-layer surface)")
+
+        def apply_fn(p, x):
+            states = model.apply({"params": p}, x, return_layers=layer)
+            return states[0][:, 0]
+    else:
+        def apply_fn(p, x):
+            return model.apply({"params": p}, x)
     if params is None:
         params = model.init(key, jnp.zeros((1, image_size, image_size, 3)))["params"]
     else:
         _validate_backbone(model, params, image_size)
-    return (lambda p, x: model.apply({"params": p}, x)), params
+    return apply_fn, params
 
 
 def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
@@ -194,7 +214,8 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
         backbone_params = load_backbone_params(cfg.pt_style, cfg.arch,
                                                cfg.weights_path)
     apply_fn, params = build_backbone(cfg.pt_style, cfg.arch, jax.random.key(0),
-                                      backbone_params, cfg.image_size)
+                                      backbone_params, cfg.image_size,
+                                      layer=cfg.layer)
     extractor = make_extractor(apply_fn, params, mesh, multiscale=cfg.multiscale)
     query_feats = SIM.l2_normalize(extract_features(query, extractor,
                                                     batch_size=cfg.batch_size))

@@ -90,3 +90,36 @@ def test_clip_scorer_cosine_range():
     s = np.asarray(scorer.score(params, images, ids))
     assert s.shape == (2,)
     assert np.all(np.abs(s) <= 1.0 + 1e-5)
+
+
+def test_build_backbone_layer_selects_intermediate_cls():
+    """--layer > 1 (reference utils_ret.py:731-745): the extractor feature
+    must equal the CLS token of get_intermediate_layers(x, layer)[0], and
+    differ from the final-layer default."""
+    from dcr_tpu.eval.runner import build_backbone
+
+    key = jax.random.key(0)
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    f1, params = build_backbone("dino", "dino_vitb_cifar10", key, None,
+                                image_size=32, layer=1)
+    f2, _ = build_backbone("dino", "dino_vitb_cifar10", key, None,
+                           image_size=32, layer=2)
+    feats1 = np.asarray(f1(params, x))
+    feats2 = np.asarray(f2(params, x))
+    assert feats1.shape == feats2.shape
+    assert not np.allclose(feats1, feats2)
+    from dcr_tpu.models.vit import DINO_ARCHS
+
+    model = DINO_ARCHS["dino_vitb_cifar10"]()
+    direct = model.apply({"params": params}, x, return_layers=2)[0][:, 0]
+    np.testing.assert_allclose(feats2, np.asarray(direct), atol=1e-6)
+
+
+def test_build_backbone_layer_rejects_non_vit():
+    from dcr_tpu.eval.runner import build_backbone
+
+    with pytest.raises(ValueError, match="DINO ViT"):
+        build_backbone("sscd", "resnet50_disc", jax.random.key(0), None,
+                       layer=2)
+    with pytest.raises(ValueError, match="DINO ViT"):
+        build_backbone("dino", "dino_resnet50", jax.random.key(0), None, layer=2)
