@@ -30,6 +30,10 @@ _PUBLIC = {
     "generate": "dcr_tpu.sampling.pipeline",
     "run_eval": "dcr_tpu.eval.runner",
     "make_mesh": "dcr_tpu.parallel.mesh",
+    "flash_attention": "dcr_tpu.ops.flash_attention",
+    "ring_self_attention": "dcr_tpu.ops.ring_attention",
+    "ulysses_self_attention": "dcr_tpu.ops.ulysses_attention",
+    "adamw8bit": "dcr_tpu.core.adam8bit",
 }
 
 

@@ -94,3 +94,17 @@ def test_hf_layout_roundtrip(tmp_path):
     back = import_hf_layout(tmp_path / "checkpoint", "unet")
     np.testing.assert_array_equal(back["conv_in"]["kernel"], unet["conv_in"]["kernel"])
     assert (tmp_path / "checkpoint" / "scheduler" / "scheduler_config.json").exists()
+
+
+@pytest.mark.fast
+def test_lazy_public_api_resolves():
+    """Every symbol in the curated lazy API imports and is callable/usable;
+    unknown names raise AttributeError (not ImportError)."""
+    import dcr_tpu
+
+    for name in dcr_tpu._PUBLIC:
+        obj = getattr(dcr_tpu, name)
+        assert obj is not None, name
+        assert name in dir(dcr_tpu)
+    with pytest.raises(AttributeError):
+        dcr_tpu.no_such_symbol
