@@ -97,15 +97,20 @@ def _validate_backbone(model, params: dict, image_size: int) -> None:
 
 def build_backbone(pt_style: str, arch: str, key: jax.Array,
                    params: Optional[dict] = None, image_size: int = 224,
-                   layer: int = 1):
+                   layer: int = 1, flatten_tokens: bool = False):
     """(apply_fn, params) for the copy-detection embedder
     (reference model zoo switch, diff_retrieval.py:249-285). Random init unless
     converted pretrained params are supplied (models/convert.py or
     load_backbone_params); supplied params are shape-validated.
 
-    layer > 1 (DINO ViTs only): CLS feature of the layer-th-from-last block —
-    get_intermediate_layers(x, layer)[0][:, 0] semantics (reference --layer,
-    utils_ret.py:731-745)."""
+    layer > 1 (DINO ViTs only): features from the layer-th-from-last block —
+    get_intermediate_layers(x, layer)[0] semantics (reference --layer,
+    utils_ret.py:726-745). Default is the CLS token ([:, 0], the dotproduct
+    path); flatten_tokens=True returns ALL tokens flattened [B, (1+hw)*D]
+    (the reference's splitloss path, which rearranges 'b h w -> b (h w)' and
+    chunks the similarity per token — apply_fn.n_tokens carries the token
+    count the caller must use as num_loss_chunks, the numpatches aliasing at
+    diff_retrieval.py:394-395)."""
     import jax.numpy as jnp
 
     if pt_style == "sscd":
@@ -127,9 +132,22 @@ def build_backbone(pt_style: str, arch: str, key: jax.Array,
                 "utils_ret.py:731, is get_intermediate_layers on the ViT; "
                 f"{pt_style}/{arch} has no intermediate-layer surface)")
 
-        def apply_fn(p, x):
-            states = model.apply({"params": p}, x, return_layers=layer)
-            return states[0][:, 0]
+        if flatten_tokens:
+            def apply_fn(p, x):
+                states = model.apply({"params": p}, x, return_layers=layer)
+                s = states[0]
+                return s.reshape(s.shape[0], -1)
+
+            apply_fn.n_tokens = (image_size // model.patch_size) ** 2 + 1
+        else:
+            def apply_fn(p, x):
+                states = model.apply({"params": p}, x, return_layers=layer)
+                return states[0][:, 0]
+    elif flatten_tokens:
+        # the reference's splitloss rearrange crashes on [B, D] outputs; only
+        # token models have a per-patch feature surface
+        raise ValueError("flatten_tokens needs a DINO ViT with layer > 1 "
+                         "(token-level features; reference utils_ret.py:729-737)")
     else:
         def apply_fn(p, x):
             return model.apply({"params": p}, x)
@@ -213,9 +231,29 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                  cfg.weights_path)
         backbone_params = load_backbone_params(cfg.pt_style, cfg.arch,
                                                cfg.weights_path)
+    # reference splitloss + dino layer>1: token-level features, similarity
+    # chunked per token (numpatches -> num_loss_chunks aliasing,
+    # diff_retrieval.py:394-395, utils_ret.py:729-737)
+    flatten_tokens = (cfg.similarity_metric == "splitloss"
+                      and cfg.pt_style == "dino" and cfg.layer > 1)
+    if flatten_tokens and cfg.multiscale:
+        raise ValueError("multiscale pools per-scale embeddings and has no "
+                         "token surface; drop --multiscale for the "
+                         "splitloss+layer token path")
     apply_fn, params = build_backbone(cfg.pt_style, cfg.arch, jax.random.key(0),
                                       backbone_params, cfg.image_size,
-                                      layer=cfg.layer)
+                                      layer=cfg.layer,
+                                      flatten_tokens=flatten_tokens)
+    num_loss_chunks = cfg.num_loss_chunks
+    if flatten_tokens:
+        if cfg.num_loss_chunks not in (1, apply_fn.n_tokens):
+            raise ValueError(
+                f"splitloss with dino layer>1 chunks per token: "
+                f"num_loss_chunks is set by the {apply_fn.n_tokens}-token "
+                f"feature layout (reference numpatches aliasing, "
+                f"diff_retrieval.py:394-395) — drop --num_loss_chunks="
+                f"{cfg.num_loss_chunks} or set it to {apply_fn.n_tokens}")
+        num_loss_chunks = apply_fn.n_tokens
     extractor = make_extractor(apply_fn, params, mesh, multiscale=cfg.multiscale)
     query_feats = SIM.l2_normalize(extract_features(query, extractor,
                                                     batch_size=cfg.batch_size))
@@ -224,7 +262,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
     sim = SIM.similarity_matrix(values_feats, query_feats,
                                 metric=cfg.similarity_metric,
-                                num_chunks=cfg.num_loss_chunks,
+                                num_chunks=num_loss_chunks,
                                 chunk_style=cfg.chunk_style, mesh=mesh)
     stats = SIM.gen_train_stats(sim)
     scalars: dict = stats.scalars()

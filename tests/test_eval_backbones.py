@@ -123,3 +123,24 @@ def test_build_backbone_layer_rejects_non_vit():
                        layer=2)
     with pytest.raises(ValueError, match="DINO ViT"):
         build_backbone("dino", "dino_resnet50", jax.random.key(0), None, layer=2)
+
+
+def test_build_backbone_token_features_for_splitloss():
+    """splitloss + dino layer>1 (reference utils_ret.py:729-737): features are
+    ALL tokens flattened, n_tokens = 1+hw carries the numpatches alias."""
+    from dcr_tpu.eval.runner import build_backbone
+
+    f, params = build_backbone("dino", "dino_vits16", jax.random.key(0), None,
+                               image_size=32, layer=2, flatten_tokens=True)
+    assert f.n_tokens == (32 // 16) ** 2 + 1   # 4 patches + CLS
+    x = jax.random.normal(jax.random.key(1), (2, 32, 32, 3))
+    feats = np.asarray(f(params, x))
+    assert feats.shape == (2, f.n_tokens * 384)
+    # first token slice equals the CLS path
+    f_cls, _ = build_backbone("dino", "dino_vits16", jax.random.key(0), None,
+                              image_size=32, layer=2)
+    np.testing.assert_allclose(feats[:, :384], np.asarray(f_cls(params, x)),
+                               atol=1e-6)
+    with pytest.raises(ValueError, match="token"):
+        build_backbone("dino", "dino_vits16", jax.random.key(0), None,
+                       image_size=32, layer=1, flatten_tokens=True)
