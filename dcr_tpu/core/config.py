@@ -207,6 +207,9 @@ class TrainConfig:
     output_dir: str = "runs/dcr"
     pretrained_model: str = ""             # HF-layout checkpoint dir to finetune from
     seed: int = 42
+    # seeds the periodic in-training sample grids independently of the train
+    # seed (reference --generation_seed, diff_train.py:121,579)
+    generation_seed: int = 1024
     train_batch_size: int = 16             # per-device
     max_train_steps: int = 100_000
     num_train_epochs: int = 100

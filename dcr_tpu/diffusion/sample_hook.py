@@ -30,8 +30,13 @@ def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 
                      max_prompts: int = 3, guidance_scale: float = 7.5):
     """Returns a hook(trainer, step) for Trainer(sample_hook=...).
 
-    Prompts: first `max_prompts` classes as "An image of {cls}" (classlevel),
-    else the instance prompt (reference samples ≤3 classes, diff_train.py:573).
+    Prompts per conditioning regime (reference diff_train.py:573-607):
+    classlevel → first `max_prompts` classes as "An image of {cls}";
+    instancelevel_* → `max_prompts` captions drawn from the training caption
+    tables seeded by generation_seed (random-token captions decoded through
+    the tokenizer); nolevel → the instance prompt. Grids are seeded by
+    cfg.generation_seed (reference --generation_seed), independent of the
+    train seed.
     """
     state = {}  # memoized jitted sampler (compile once)
 
@@ -41,11 +46,28 @@ def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 
             px = vae_scale_factor(cfg.model) * cfg.model.sample_size
             scfg = SampleConfig(
                 resolution=px, num_inference_steps=num_inference_steps,
-                guidance_scale=guidance_scale, sampler="ddim", seed=cfg.seed)
+                guidance_scale=guidance_scale, sampler="ddim",
+                seed=cfg.generation_seed)
             state["sampler"] = make_sampler(scfg, trainer.models, trainer.mesh)
-            if cfg.data.class_prompt == "classlevel":
+            style = cfg.data.class_prompt
+            if style == "classlevel":
                 names = trainer.dataset.classnames[:max_prompts]
                 state["prompts"] = [f"An image of {c}" for c in names]
+            elif style.startswith("instancelevel") and trainer.dataset.prompts:
+                from dcr_tpu.sampling.prompts import sample_caption_prompts
+
+                # active paths only: under trainsubset the grid must not be
+                # conditioned on captions of images excluded from training
+                # (reference truncates choicelist, diff_train.py:466-468)
+                ds = trainer.dataset
+                caption_lists = [ds.prompts[p]
+                                 for p in (ds.paths[int(i)]
+                                           for i in ds.active_indices)
+                                 if p in ds.prompts]
+                state["prompts"] = sample_caption_prompts(
+                    caption_lists, style, max_prompts,
+                    seed=cfg.generation_seed, tokenizer=trainer.tokenizer,
+                    stream="train_sample_prompts")
             else:
                 state["prompts"] = [cfg.data.instance_prompt]
             ids = trainer.tokenizer(state["prompts"])
@@ -65,8 +87,8 @@ def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 
             "vae": trainer.state.vae_params,
             "text": trainer.state.text_params,
         }
-        key = rngmod.step_key(rngmod.stream_key(rngmod.root_key(cfg.seed),
-                                                "train_samples"), step)
+        key = rngmod.step_key(rngmod.stream_key(
+            rngmod.root_key(cfg.generation_seed), "train_samples"), step)
         images = pmesh.to_host(state["sampler"](params, state["ids"],
                                                 state["uncond"], key))[: state["real"]]
         if dist.is_primary():
@@ -76,4 +98,5 @@ def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 
             grid.save(out / f"step_{step}.png")
             log.info("sample grid -> %s", out / f"step_{step}.png")
 
+    hook.state = state             # inspectable by callers/tests
     return hook

@@ -46,6 +46,29 @@ def prompt_augmentation(prompt: str, aug_style: str, *, tokenizer: TokenizerBase
     return prompt
 
 
+def sample_caption_prompts(caption_lists: Sequence[Sequence[str]], style: str,
+                           count: int, *, seed: int,
+                           tokenizer: TokenizerBase,
+                           stream: str = "prompt_list") -> list[str]:
+    """`count` seeded draws over the FIRST caption of each image's caption
+    list (reference semantics: choicelist = [x[0] for x in prompts.values()],
+    diff_train.py:462-463); instancelevel_random entries are token-id
+    literals decoded through the tokenizer. Shared by the inference prompt
+    builder and the in-training sample-grid hook."""
+    choicelist = [str(caps[0]) for caps in caption_lists if caps]
+    if not choicelist:
+        raise ValueError("no captions to sample prompts from")
+    rng = host_python_rng(seed, stream)
+    # draws are WITH replacement (reference np.random.choice), so count may
+    # exceed the table size
+    picks = [choicelist[int(i)]
+             for i in rng.integers(0, len(choicelist), size=count)]
+    if style == "instancelevel_random":
+        picks = [tokenizer.decode([int(t) for t in ast.literal_eval(p)])
+                 for p in picks]
+    return picks
+
+
 def build_prompt_list(style: str, count: int, *, seed: int,
                       tokenizer: TokenizerBase,
                       instance_prompt: str = "An image",
@@ -63,12 +86,10 @@ def build_prompt_list(style: str, count: int, *, seed: int,
         if caption_json is None:
             raise ValueError(f"{style} needs a caption_json")
         table = json.loads(Path(caption_json).read_text())
-        first_caps = [v[0] for v in table.values()]
-        prompts = [str(first_caps[i])
-                   for i in rng.integers(0, len(first_caps), size=count)]
-        if style == "instancelevel_random":
-            prompts = [tokenizer.decode([int(t) for t in ast.literal_eval(p)])
-                       for p in prompts]
+        # fresh "prompt_list" stream == the draw sequence this branch always
+        # used (rng above is untouched before this point)
+        prompts = sample_caption_prompts(list(table.values()), style, count,
+                                         seed=seed, tokenizer=tokenizer)
     else:
         raise ValueError(f"unknown conditioning style {style!r}")
 

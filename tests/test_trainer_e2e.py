@@ -204,3 +204,41 @@ def test_sync_step_cadence_with_grad_accum(train_setup):
     steps = trainer.ckpt.all_steps()  # checkpoint labels stay micro-step
     assert 4 in steps and 8 in steps
     assert hook_calls == [3]
+
+
+def test_sample_hook_instancelevel_prompts_from_captions(train_setup):
+    """instancelevel_blip grids draw their prompts from the training caption
+    tables, seeded by generation_seed (reference diff_train.py:579-607) —
+    not from classnames or the instance prompt."""
+    import json as _json
+
+    from dcr_tpu.diffusion.sample_hook import make_sample_hook
+
+    cfg, base = train_setup
+    table = {}
+    for cls in ("c0", "c1"):
+        for p in sorted((base / "data" / cls).glob("*.png")):
+            table[str(p)] = [f"a photo about {cls}/{p.stem}"]
+    cap_json = base / "blip.json"
+    cap_json.write_text(_json.dumps(table))
+    cfg.output_dir = str(base / "run_hook_blip")
+    cfg.save_steps = 2
+    cfg.max_train_steps = 2
+    cfg.data.class_prompt = "instancelevel_blip"
+    cfg.data.caption_jsons = (str(cap_json),)
+    cfg.train_batch_size = 1         # global batch 8 fits the 10-image subset
+    cfg.data.trainsubset = 10        # grid prompts must respect the subset
+    hook = make_sample_hook(num_inference_steps=2, images_per_prompt=2,
+                            max_prompts=2)
+    trainer = Trainer(cfg, sample_hook=hook)
+    trainer.train()
+    grids = list((base / "run_hook_blip" / "generations").glob("step_*.png"))
+    assert grids, "no sample grids written"
+    # provenance: prompts came from the caption table (first captions), and
+    # only from images inside the training subset
+    active_paths = {trainer.dataset.paths[int(i)]
+                    for i in trainer.dataset.active_indices}
+    allowed = {table[p][0] for p in table if p in active_paths}
+    assert hook.state["prompts"], "hook never selected prompts"
+    for p in hook.state["prompts"]:
+        assert p in allowed, (p, sorted(allowed)[:3])
