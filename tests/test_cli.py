@@ -25,6 +25,18 @@ def _images(dirpath, n, seed=0, size=20):
             dirpath / f"{i}.png")
 
 
+def _train_cfg(tmp_path, *, class_prompt):
+    """One source of truth for the CLI tests' tiny train config."""
+    return TrainConfig(
+        output_dir=str(tmp_path / "run"), seed=0, train_batch_size=2,
+        max_train_steps=2, mixed_precision="no", save_steps=1000,
+        modelsavesteps=1000, log_every=1, model=ModelConfig.tiny(),
+        data=DataConfig(train_data_dir=str(tmp_path / "data"), resolution=16,
+                        class_prompt=class_prompt, num_workers=2, seed=0),
+        optim=OptimConfig(learning_rate=1e-4, lr_scheduler="constant",
+                          lr_warmup_steps=0))
+
+
 @pytest.fixture(scope="module")
 def cli_ckpt(tmp_path_factory):
     """Tiny HF-layout checkpoint + run dir with config.json, as dcr-train
@@ -58,14 +70,7 @@ def test_cli_train_main(tmp_path, cpu_devices):
 
     _images(tmp_path / "data" / "c0", 8, seed=1)
     _images(tmp_path / "data" / "c1", 8, seed=2)
-    cfg = TrainConfig(
-        output_dir=str(tmp_path / "run"), seed=0, train_batch_size=2,
-        max_train_steps=2, mixed_precision="no", save_steps=1000,
-        modelsavesteps=1000, log_every=1, model=ModelConfig.tiny(),
-        data=DataConfig(train_data_dir=str(tmp_path / "data"), resolution=16,
-                        class_prompt="nolevel", num_workers=2, seed=0),
-        optim=OptimConfig(learning_rate=1e-4, lr_scheduler="constant",
-                          lr_warmup_steps=0))
+    cfg = _train_cfg(tmp_path, class_prompt="nolevel")
     save_config(cfg, tmp_path / "cfg.json")
     cli_train.main([f"--config={tmp_path / 'cfg.json'}",
                     "--max_train_steps=2"])          # dotted override on top
@@ -162,6 +167,56 @@ def test_cli_search_embed_and_search(tmp_path, cpu_devices):
     assert (tmp_path / "gens" / "embedding.npz").exists()
     out = tmp_path / "result.npz"
     cli_search.main(["search", f"--gen_folder={tmp_path / 'gens'}",
+                     f"--laion_folder={tmp_path / 'laion'}",
+                     f"--out_path={out}"])
+    res = np.load(out, allow_pickle=True)
+    assert len(res["scores"]) == 3
+
+
+def test_full_chain_train_sample_evaluate_search(tmp_path, cpu_devices):
+    """The reference's complete four-stage workflow on ONE set of artifacts:
+    train writes a checkpoint, sample reads it and writes generations,
+    evaluate compares those generations to the training data, search embeds
+    and matches them against a LAION-style chunk — every filesystem contract
+    between stages exercised in sequence (reference: diff_train ->
+    diff_inference -> diff_retrieval -> embedding_search)."""
+    from dcr_tpu.cli import evaluate as cli_evaluate
+    from dcr_tpu.cli import sample as cli_sample
+    from dcr_tpu.cli import search as cli_search
+    from dcr_tpu.cli import train as cli_train
+
+    _images(tmp_path / "data" / "c0", 8, seed=11)
+    _images(tmp_path / "data" / "c1", 8, seed=12)
+    run = tmp_path / "run"
+    cfg = _train_cfg(tmp_path, class_prompt="classlevel")
+    save_config(cfg, tmp_path / "cfg.json")
+    cli_train.main([f"--config={tmp_path / 'cfg.json'}"])
+
+    inf = tmp_path / "inf"
+    cli_sample.main([f"--model_path={run}", f"--savepath={inf}",
+                     "--num_batches=3", "--im_batch=1", "--resolution=16",
+                     "--num_inference_steps=2", "--sampler=ddim", "--seed=0"])
+    gens = inf / "generations"
+    assert len(list(gens.glob("*.png"))) == 3
+
+    plots = tmp_path / "plots"
+    cli_evaluate.main([
+        f"--query_dir={gens}", f"--values_dir={tmp_path / 'data'}",
+        "--pt_style=sscd", "--arch=resnet50_disc", "--batch_size=2",
+        "--image_size=32", "--compute_fid=false",
+        "--compute_clip_score=false", "--compute_complexity=false",
+        "--galleries=false", f"--output_dir={plots}"])
+    sim = np.load(plots / "similarity.npy")
+    assert sim.shape == (3, 16)          # 3 generations vs 16 train images
+
+    cli_search.main(["embed", f"--gen_folder={gens}",
+                     "--image_size=32", "--batch_size=2"])
+    chunk = tmp_path / "laion" / "chunk0"
+    _images(chunk, 4, seed=13)
+    cli_search.main(["embed", f"--gen_folder={chunk}",
+                     "--image_size=32", "--batch_size=2"])
+    out = tmp_path / "search.npz"
+    cli_search.main(["search", f"--gen_folder={gens}",
                      f"--laion_folder={tmp_path / 'laion'}",
                      f"--out_path={out}"])
     res = np.load(out, allow_pickle=True)
