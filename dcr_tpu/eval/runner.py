@@ -63,6 +63,8 @@ def load_backbone_params(pt_style: str, arch: str, path: str) -> dict:
     if pt_style == "dino":
         if arch == "dino_resnet50":
             return {"backbone": CV.convert_resnet50(sd)}
+        if arch.startswith("dino_xcit"):
+            return CV.convert_xcit(sd)
         return CV.convert_dino_vit(sd)
     if pt_style == "clip":
         return CV.convert_clip_image(sd)

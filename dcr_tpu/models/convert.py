@@ -215,6 +215,63 @@ def convert_dino_vit(sd: StateDict, depth: int = 12) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# XCiT (facebookresearch/xcit naming, DINO hub checkpoints
+# dino_vits.py:413-487) -> models.xcit.XCiT
+# ---------------------------------------------------------------------------
+
+def convert_xcit(sd: StateDict) -> dict:
+    """Depth / patch size / cls-attn count are inferred from the key set, so
+    one converter serves all four dino_xcit_* checkpoints."""
+    def layer_count(prefix: str) -> int:
+        idx = [int(m.group(1)) for k in sd
+               if (m := re.match(rf"{prefix}\.(\d+)\.", k))]
+        if not idx:
+            raise ValueError(
+                f"not an XCiT state dict: no '{prefix}.N.*' keys "
+                f"(got e.g. {sorted(sd)[:3]})")
+        return 1 + max(idx)
+
+    depth = layer_count("blocks")
+    n_cls = layer_count("cls_attn_blocks")
+    # /16 embeds through 4 conv stages (Sequential indices 0,2,4,6 with GELU
+    # between), /8 through 3 (0,2,4)
+    stages = [i for i in (0, 2, 4, 6) if f"patch_embed.proj.{i}.0.weight" in sd]
+    t: dict = {}
+    _set(t, "cls_token", sd["cls_token"].reshape(1, 1, -1))
+    _conv(t, "pos_embeder/token_projection", sd, "pos_embeder.token_projection")
+    for dst_i, src_i in enumerate(stages):
+        _conv(t, f"patch_embed/conv{dst_i}", sd, f"patch_embed.proj.{src_i}.0")
+        _batchnorm(t, f"patch_embed/bn{dst_i}", sd, f"patch_embed.proj.{src_i}.1")
+    for i in range(depth):
+        src, dst = f"blocks.{i}", f"blocks_{i}"
+        for g in ("gamma1", "gamma2", "gamma3"):
+            _set(t, f"{dst}/{g}", sd[f"{src}.{g}"])
+        _layernorm(t, f"{dst}/norm1", sd, f"{src}.norm1")
+        _set(t, f"{dst}/attn/temperature", sd[f"{src}.attn.temperature"])
+        _linear(t, f"{dst}/attn/qkv", sd, f"{src}.attn.qkv")
+        _linear(t, f"{dst}/attn/proj", sd, f"{src}.attn.proj")
+        _layernorm(t, f"{dst}/norm3", sd, f"{src}.norm3")
+        _conv(t, f"{dst}/local_mp/conv1", sd, f"{src}.local_mp.conv1")
+        _batchnorm(t, f"{dst}/local_mp/bn", sd, f"{src}.local_mp.bn")
+        _conv(t, f"{dst}/local_mp/conv2", sd, f"{src}.local_mp.conv2")
+        _layernorm(t, f"{dst}/norm2", sd, f"{src}.norm2")
+        _linear(t, f"{dst}/mlp/fc1", sd, f"{src}.mlp.fc1")
+        _linear(t, f"{dst}/mlp/fc2", sd, f"{src}.mlp.fc2")
+    for i in range(n_cls):
+        src, dst = f"cls_attn_blocks.{i}", f"cls_attn_blocks_{i}"
+        for g in ("gamma1", "gamma2"):
+            _set(t, f"{dst}/{g}", sd[f"{src}.{g}"])
+        _layernorm(t, f"{dst}/norm1", sd, f"{src}.norm1")
+        _linear(t, f"{dst}/attn/qkv", sd, f"{src}.attn.qkv")
+        _linear(t, f"{dst}/attn/proj", sd, f"{src}.attn.proj")
+        _layernorm(t, f"{dst}/norm2", sd, f"{src}.norm2")
+        _linear(t, f"{dst}/mlp/fc1", sd, f"{src}.mlp.fc1")
+        _linear(t, f"{dst}/mlp/fc2", sd, f"{src}.mlp.fc2")
+    _layernorm(t, "norm", sd, "norm")
+    return t
+
+
+# ---------------------------------------------------------------------------
 # HF CLIPTextModel (transformers naming) -> models.clip_text.CLIPTextModel
 # ---------------------------------------------------------------------------
 

@@ -176,6 +176,17 @@ def _dino_resnet50():
     return ResNet50Classifier()
 
 
+def _xcit(name: str):
+    # imported lazily so vit.py stays importable without pulling resnet
+    # (xcit reuses FrozenBatchNorm) until an xcit arch is actually built
+    from dcr_tpu.models import xcit
+
+    size, patch = name.rsplit("_p", 1)
+    ctor = {"xcit_small_12": xcit.xcit_small_12,
+            "xcit_medium_24": xcit.xcit_medium_24}[size]
+    return ctor(patch_size=int(patch))
+
+
 DINO_ARCHS = {
     "dino_vits16": lambda: vit_small(16),
     "dino_vits8": lambda: vit_small(8),
@@ -186,4 +197,9 @@ DINO_ARCHS = {
     # 32px grid
     "dino_vitb_cifar10": lambda: vit_base(16),
     "dino_resnet50": _dino_resnet50,
+    # XCiT hub family (reference dino_vits.py:413-487)
+    "dino_xcit_small_12_p16": lambda: _xcit("xcit_small_12_p16"),
+    "dino_xcit_small_12_p8": lambda: _xcit("xcit_small_12_p8"),
+    "dino_xcit_medium_24_p16": lambda: _xcit("xcit_medium_24_p16"),
+    "dino_xcit_medium_24_p8": lambda: _xcit("xcit_medium_24_p8"),
 }

@@ -1,0 +1,290 @@
+"""XCiT (Cross-Covariance Image Transformer) — DINO copy-detection backbone.
+
+The reference's last backbone family: its hub constructors
+(/root/reference/dino_vits.py:413-487) pull ``xcit_small_12_p16`` /
+``xcit_small_12_p8`` / ``xcit_medium_24_p16`` / ``xcit_medium_24_p8`` from
+``facebookresearch/xcit`` and load DINO-pretrained state dicts. There is no
+XCiT source in the reference repo, so this is implemented fresh from the
+published architecture (El-Nouby et al., "XCiT: Cross-Covariance Image
+Transformers", NeurIPS 2021) in Flax/NHWC:
+
+- ``ConvPatchEmbed``: a stride-2 conv3x3+BN stack (4 stages for /16,
+  3 for /8) instead of one big patchify conv;
+- ``PositionalEncodingFourier``: 2D sinusoidal encoding projected by a
+  1x1 conv (the only learned part of the positional signal);
+- ``XCA``: attention over the *channel* dimension — L2-normalised q/k,
+  a learned per-head temperature, d×d attention (linear in tokens);
+- ``LPI``: depthwise 3x3 → GELU → BN → depthwise 3x3 on the token grid;
+- two CaiT-style class-attention layers that inject the CLS token after
+  the trunk (only CLS attends; patch tokens ride along).
+
+Pretrained hub checkpoints load through models/convert.convert_xcit;
+activation parity vs an independent torch twin is tested in
+tests/test_torch_parity.py.
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dcr_tpu.models.resnet import FrozenBatchNorm
+
+
+def _gelu(x: jax.Array) -> jax.Array:
+    # exact erf form — torch nn.GELU's default; the tanh approximation
+    # drifts ~1e-3 and fails twin parity at fp32 tolerances
+    return nn.gelu(x, approximate=False)
+
+
+class PositionalEncodingFourier(nn.Module):
+    """Sinusoidal 2D position signal -> 1x1 conv projection to ``dim``.
+
+    Matches the hub models' ``pos_embeder`` (their spelling): per-axis
+    cumulative positions normalised to (0, 2π], sin/cos over a
+    ``hidden_dim``-frequency bank with temperature 10000, y-bank then
+    x-bank concatenated, projected channelwise."""
+
+    dim: int
+    hidden_dim: int = 32
+    temperature: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: int, w: int) -> jax.Array:
+        eps = 1e-6
+        scale = 2 * math.pi
+        y = (jnp.arange(1, h + 1, dtype=jnp.float32) / (h + eps) * scale)
+        x = (jnp.arange(1, w + 1, dtype=jnp.float32) / (w + eps) * scale)
+        dim_t = jnp.arange(self.hidden_dim, dtype=jnp.float32)
+        dim_t = self.temperature ** (2 * (dim_t // 2) / self.hidden_dim)
+
+        def bank(pos):  # [L] -> [L, hidden_dim], interleaved sin/cos
+            t = pos[:, None] / dim_t                       # [L, hidden]
+            pair = jnp.stack([jnp.sin(t[:, 0::2]), jnp.cos(t[:, 1::2])], axis=-1)
+            return pair.reshape(pos.shape[0], self.hidden_dim)
+
+        py = jnp.broadcast_to(bank(y)[:, None, :], (h, w, self.hidden_dim))
+        px = jnp.broadcast_to(bank(x)[None, :, :], (h, w, self.hidden_dim))
+        pos = jnp.concatenate([py, px], axis=-1)[None]     # [1, h, w, 2*hidden]
+        pos = nn.Conv(self.dim, (1, 1), dtype=self.dtype,
+                      name="token_projection")(pos.astype(self.dtype))
+        return pos.reshape(1, h * w, self.dim)
+
+
+class ConvPatchEmbed(nn.Module):
+    """Stride-2 conv3x3+BN tower: 4 stages for patch 16, 3 for patch 8.
+    Channel plan doubles up to ``embed_dim`` (dim/8 -> dim/4 -> dim/2 -> dim
+    for /16), GELU between stages, no activation after the last."""
+
+    patch_size: int = 16
+    embed_dim: int = 384
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.patch_size == 16:
+            widths = (self.embed_dim // 8, self.embed_dim // 4,
+                      self.embed_dim // 2, self.embed_dim)
+        elif self.patch_size == 8:
+            widths = (self.embed_dim // 4, self.embed_dim // 2, self.embed_dim)
+        else:
+            raise ValueError(f"XCiT patch_size must be 8 or 16, got {self.patch_size}")
+        for i, width in enumerate(widths):
+            if i:
+                x = _gelu(x)
+            # torch Conv2d(k=3, s=2, p=1): one leading + one trailing pad row
+            x = nn.Conv(width, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)),
+                        use_bias=False, dtype=self.dtype, name=f"conv{i}")(x)
+            x = FrozenBatchNorm(name=f"bn{i}")(x)
+        b, h, w, c = x.shape
+        return x.reshape(b, h * w, c), (h, w)
+
+
+class XCA(nn.Module):
+    """Cross-covariance attention: softmax over a d_head×d_head channel
+    Gram matrix of L2-normalised q/k, scaled by a learned per-head
+    temperature — cost linear in token count."""
+
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, n, c = x.shape
+        d = c // self.num_heads
+        temperature = self.param("temperature", nn.initializers.ones,
+                                 (self.num_heads, 1, 1))
+        qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # [B, N, C] -> [B, heads, d_head, N]: attention lives on channels
+        shape = lambda t: t.reshape(b, n, self.num_heads, d).transpose(0, 2, 3, 1)
+        q, k, v = shape(q), shape(k), shape(v)
+        norm = lambda t: t / jnp.maximum(
+            jnp.linalg.norm(t, axis=-1, keepdims=True), 1e-12)  # torch F.normalize
+        attn = jnp.einsum("bhdn,bhen->bhde", norm(q), norm(k)) * temperature
+        attn = jax.nn.softmax(attn, axis=-1)
+        out = jnp.einsum("bhde,bhen->bhdn", attn, v)
+        out = out.transpose(0, 3, 1, 2).reshape(b, n, c)
+        return nn.Dense(c, dtype=self.dtype, name="proj")(out)
+
+
+class LPI(nn.Module):
+    """Local Patch Interaction: two depthwise 3x3 convs over the token grid
+    with GELU+BN between — XCiT's substitute for token mixing."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, hw: tuple[int, int]) -> jax.Array:
+        b, n, c = x.shape
+        h, w = hw
+        g = x.reshape(b, h, w, c)
+        dw = lambda name: nn.Conv(c, (3, 3), padding=((1, 1), (1, 1)),
+                                  feature_group_count=c, dtype=self.dtype,
+                                  name=name)
+        g = dw("conv1")(g)
+        g = _gelu(g)
+        g = FrozenBatchNorm(name="bn")(g)
+        g = dw("conv2")(g)
+        return g.reshape(b, n, c)
+
+
+class Mlp(nn.Module):
+    hidden: int
+    out: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.Dense(self.hidden, dtype=self.dtype, name="fc1")(x)
+        x = _gelu(x)
+        return nn.Dense(self.out, dtype=self.dtype, name="fc2")(x)
+
+
+class XCABlock(nn.Module):
+    """Trunk layer: LayerScale'd XCA, LPI, and MLP residual branches
+    (order: attention, local patch interaction, MLP)."""
+
+    num_heads: int
+    mlp_ratio: float = 4.0
+    eta: float = 1.0     # LayerScale init (1.0 small_12, 1e-5 medium_24)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, hw: tuple[int, int]) -> jax.Array:
+        c = x.shape[-1]
+        gamma = lambda name: self.param(
+            name, nn.initializers.constant(self.eta), (c,))
+        h = XCA(self.num_heads, dtype=self.dtype, name="attn")(
+            nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm1")(x))
+        x = x + gamma("gamma1") * h
+        h = LPI(dtype=self.dtype, name="local_mp")(
+            nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm3")(x), hw)
+        x = x + gamma("gamma3") * h
+        h = Mlp(int(c * self.mlp_ratio), c, dtype=self.dtype, name="mlp")(
+            nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm2")(x))
+        return x + gamma("gamma2") * h
+
+
+class ClassAttention(nn.Module):
+    """CaiT-style class attention: only the CLS query attends over all
+    tokens; the non-CLS rows of the (normed) input pass through unchanged."""
+
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b, n, c = x.shape
+        d = c // self.num_heads
+        qkv = nn.Dense(3 * c, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = lambda t: t.reshape(b, n, self.num_heads, d).transpose(0, 2, 1, 3)
+        q, k, v = shape(q), shape(k), shape(v)          # [B, h, N, d]
+        qc = q[:, :, :1]                                 # CLS query only
+        attn = jnp.sum(qc * k, axis=-1) * (d ** -0.5)    # [B, h, N]
+        attn = jax.nn.softmax(attn, axis=-1)
+        cls = jnp.einsum("bhn,bhnd->bhd", attn, v).reshape(b, 1, c)
+        cls = nn.Dense(c, dtype=self.dtype, name="proj")(cls)
+        return jnp.concatenate([cls, x[:, 1:]], axis=1)
+
+
+class ClassAttentionBlock(nn.Module):
+    """Class-attention layer with ``tokens_norm=True`` (the hub models'
+    setting): norm2 runs over every token, and the final residual adds the
+    post-norm tokens back onto the [γ2·MLP(CLS), patches] concat — patch
+    tokens pick up a doubling the original keeps; CLS output is what DINO
+    consumes and LayerNorm's scale invariance makes the next block blind
+    to the factor, but we reproduce it exactly for hub-weight fidelity."""
+
+    num_heads: int
+    mlp_ratio: float = 4.0
+    eta: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        gamma = lambda name: self.param(
+            name, nn.initializers.constant(self.eta), (c,))
+        h = ClassAttention(self.num_heads, dtype=self.dtype, name="attn")(
+            nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm1")(x))
+        x = x + gamma("gamma1") * h
+        x = nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm2")(x)
+        cls = gamma("gamma2") * Mlp(int(c * self.mlp_ratio), c,
+                                    dtype=self.dtype, name="mlp")(x[:, :1])
+        return x + jnp.concatenate([cls, x[:, 1:]], axis=1)
+
+
+class XCiT(nn.Module):
+    """Full XCiT trunk; returns the CLS embedding [B, embed_dim] (head is
+    identity for ``num_classes=0``, the reference's retrieval setting).
+
+    Token count is H/p * W/p for any input divisible by stage strides —
+    no positional table to interpolate (the Fourier encoding is generated
+    for the actual grid), so arbitrary eval resolutions come for free."""
+
+    patch_size: int = 16
+    embed_dim: int = 384
+    depth: int = 12
+    num_heads: int = 8
+    mlp_ratio: float = 4.0
+    cls_attn_layers: int = 2
+    eta: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b = x.shape[0]
+        tokens, hw = ConvPatchEmbed(self.patch_size, self.embed_dim,
+                                    dtype=self.dtype, name="patch_embed")(x)
+        pos = PositionalEncodingFourier(self.embed_dim, dtype=self.dtype,
+                                        name="pos_embeder")(*hw)
+        tokens = tokens + pos
+        for i in range(self.depth):
+            tokens = XCABlock(self.num_heads, self.mlp_ratio, eta=self.eta,
+                              dtype=self.dtype, name=f"blocks_{i}")(tokens, hw)
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.embed_dim))
+        tokens = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.embed_dim)).astype(self.dtype),
+             tokens], axis=1)
+        for i in range(self.cls_attn_layers):
+            tokens = ClassAttentionBlock(
+                self.num_heads, self.mlp_ratio, eta=self.eta,
+                dtype=self.dtype, name=f"cls_attn_blocks_{i}")(tokens)
+        return nn.LayerNorm(epsilon=1e-6, dtype=self.dtype, name="norm")(tokens)[:, 0]
+
+
+# hub-model hyperparameters (facebookresearch/xcit registry as consumed by
+# the reference's dino_xcit_* constructors, dino_vits.py:413-487)
+def xcit_small_12(patch_size: int = 16, **kw) -> XCiT:
+    return XCiT(patch_size, 384, 12, 8, eta=1.0, **kw)
+
+
+def xcit_medium_24(patch_size: int = 16, **kw) -> XCiT:
+    return XCiT(patch_size, 512, 24, 8, eta=1e-5, **kw)

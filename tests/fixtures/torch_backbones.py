@@ -240,6 +240,217 @@ class TorchInceptionFID(nn.Module):
         return x.mean(dim=(2, 3))
 
 
+class _XcitConvBN(nn.Sequential):
+    """conv3x3(s=2, bias=False) + BN — the xcit repo's patch-embed cell."""
+
+    def __init__(self, in_ch: int, out_ch: int):
+        super().__init__(nn.Conv2d(in_ch, out_ch, 3, stride=2, padding=1,
+                                   bias=False),
+                         nn.BatchNorm2d(out_ch))
+
+
+class XcitConvPatchEmbed(nn.Module):
+    """Stride-2 conv tower; Sequential indices 0/2/4(/6) with GELU between,
+    matching the hub checkpoints' `patch_embed.proj.{i}.{0,1}` keys."""
+
+    def __init__(self, patch_size: int, embed_dim: int):
+        super().__init__()
+        if patch_size == 16:
+            plan = (embed_dim // 8, embed_dim // 4, embed_dim // 2, embed_dim)
+        else:  # patch 8
+            plan = (embed_dim // 4, embed_dim // 2, embed_dim)
+        mods, in_ch = [], 3
+        for i, out_ch in enumerate(plan):
+            if i:
+                mods.append(nn.GELU())
+            mods.append(_XcitConvBN(in_ch, out_ch))
+            in_ch = out_ch
+        self.proj = nn.Sequential(*mods)
+
+    def forward(self, x):
+        x = self.proj(x)
+        hp, wp = x.shape[2], x.shape[3]
+        return x.flatten(2).transpose(1, 2), (hp, wp)
+
+
+class XcitPositionalEncodingFourier(nn.Module):
+    """2D sinusoidal encoding -> 1x1 conv (`token_projection`), hidden 32,
+    temperature 10000, positions cumsum-normalised to (0, 2pi]."""
+
+    def __init__(self, dim: int, hidden_dim: int = 32, temperature: float = 1e4):
+        super().__init__()
+        self.token_projection = nn.Conv2d(hidden_dim * 2, dim, kernel_size=1)
+        self.hidden_dim = hidden_dim
+        self.temperature = temperature
+
+    def forward(self, b, h, w):
+        import math
+
+        eps, scale = 1e-6, 2 * math.pi
+        y = torch.arange(1, h + 1, dtype=torch.float32) / (h + eps) * scale
+        x = torch.arange(1, w + 1, dtype=torch.float32) / (w + eps) * scale
+        dim_t = torch.arange(self.hidden_dim, dtype=torch.float32)
+        dim_t = self.temperature ** (2 * torch.div(dim_t, 2, rounding_mode="floor")
+                                     / self.hidden_dim)
+
+        def bank(pos):
+            t = pos[:, None] / dim_t
+            return torch.stack((t[:, 0::2].sin(), t[:, 1::2].cos()),
+                               dim=2).flatten(1)
+
+        py = bank(y)[:, None, :].expand(h, w, self.hidden_dim)
+        px = bank(x)[None, :, :].expand(h, w, self.hidden_dim)
+        pos = torch.cat((py, px), dim=2).permute(2, 0, 1)[None]
+        return self.token_projection(pos).expand(b, -1, -1, -1)
+
+
+class XcitXCA(nn.Module):
+    """Cross-covariance attention: softmax over the per-head channel Gram
+    matrix of L2-normalised q/k, learned per-head temperature."""
+
+    def __init__(self, dim: int, num_heads: int):
+        super().__init__()
+        self.num_heads = num_heads
+        self.temperature = nn.Parameter(torch.ones(num_heads, 1, 1))
+        self.qkv = nn.Linear(dim, dim * 3, bias=True)
+        self.proj = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        b, n, c = x.shape
+        qkv = self.qkv(x).reshape(b, n, 3, self.num_heads, c // self.num_heads)
+        q, k, v = qkv.permute(2, 0, 3, 1, 4).unbind(0)
+        q = F.normalize(q.transpose(-2, -1), dim=-1)
+        k = F.normalize(k.transpose(-2, -1), dim=-1)
+        v = v.transpose(-2, -1)
+        attn = (q @ k.transpose(-2, -1)) * self.temperature
+        attn = attn.softmax(dim=-1)
+        return self.proj((attn @ v).permute(0, 3, 1, 2).reshape(b, n, c))
+
+
+class XcitLPI(nn.Module):
+    """depthwise 3x3 -> GELU -> BN -> depthwise 3x3 over the token grid."""
+
+    def __init__(self, dim: int):
+        super().__init__()
+        self.conv1 = nn.Conv2d(dim, dim, 3, padding=1, groups=dim)
+        self.act = nn.GELU()
+        self.bn = nn.BatchNorm2d(dim)
+        self.conv2 = nn.Conv2d(dim, dim, 3, padding=1, groups=dim)
+
+    def forward(self, x, h, w):
+        b, n, c = x.shape
+        g = x.permute(0, 2, 1).reshape(b, c, h, w)
+        g = self.conv2(self.bn(self.act(self.conv1(g))))
+        return g.reshape(b, c, n).permute(0, 2, 1)
+
+
+class XcitMlp(nn.Module):
+    def __init__(self, dim: int, hidden: int):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, dim)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+class XcitBlock(nn.Module):
+    """Trunk layer: LayerScale'd XCA / LPI / MLP residual branches."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float, eta: float):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, eps=1e-6)
+        self.attn = XcitXCA(dim, num_heads)
+        self.norm3 = nn.LayerNorm(dim, eps=1e-6)
+        self.local_mp = XcitLPI(dim)
+        self.norm2 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp = XcitMlp(dim, int(dim * mlp_ratio))
+        self.gamma1 = nn.Parameter(eta * torch.ones(dim))
+        self.gamma2 = nn.Parameter(eta * torch.ones(dim))
+        self.gamma3 = nn.Parameter(eta * torch.ones(dim))
+
+    def forward(self, x, h, w):
+        x = x + self.gamma1 * self.attn(self.norm1(x))
+        x = x + self.gamma3 * self.local_mp(self.norm3(x), h, w)
+        return x + self.gamma2 * self.mlp(self.norm2(x))
+
+
+class XcitClassAttention(nn.Module):
+    """CaiT class attention: only the CLS query attends; patch rows of the
+    (normed) input pass through."""
+
+    def __init__(self, dim: int, num_heads: int):
+        super().__init__()
+        self.num_heads = num_heads
+        self.scale = (dim // num_heads) ** -0.5
+        self.qkv = nn.Linear(dim, dim * 3, bias=True)
+        self.proj = nn.Linear(dim, dim)
+
+    def forward(self, x):
+        b, n, c = x.shape
+        qkv = self.qkv(x).reshape(b, n, 3, self.num_heads, c // self.num_heads)
+        q, k, v = qkv.permute(2, 0, 3, 1, 4).unbind(0)
+        attn = (q[:, :, :1] * k).sum(-1) * self.scale
+        attn = attn.softmax(dim=-1)
+        cls = (attn.unsqueeze(2) @ v).transpose(1, 2).reshape(b, 1, c)
+        return torch.cat([self.proj(cls), x[:, 1:]], dim=1)
+
+
+class XcitClassAttentionBlock(nn.Module):
+    """tokens_norm=True variant (the hub models'): norm2 over all tokens;
+    final residual adds post-norm tokens onto [gamma2*mlp(cls), patches]
+    (the original's patch-token doubling, reproduced deliberately)."""
+
+    def __init__(self, dim: int, num_heads: int, mlp_ratio: float, eta: float):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, eps=1e-6)
+        self.attn = XcitClassAttention(dim, num_heads)
+        self.norm2 = nn.LayerNorm(dim, eps=1e-6)
+        self.mlp = XcitMlp(dim, int(dim * mlp_ratio))
+        self.gamma1 = nn.Parameter(eta * torch.ones(dim))
+        self.gamma2 = nn.Parameter(eta * torch.ones(dim))
+
+    def forward(self, x):
+        x = x + self.gamma1 * self.attn(self.norm1(x))
+        x = self.norm2(x)
+        cls = self.gamma2 * self.mlp(x[:, :1])
+        return x + torch.cat([cls, x[:, 1:]], dim=1)
+
+
+class TorchXCiT(nn.Module):
+    """facebookresearch/xcit trunk with hub state-dict naming (cls_token,
+    pos_embeder, patch_embed.proj.*, blocks.*, cls_attn_blocks.*, norm);
+    num_classes=0 semantics — returns the CLS embedding."""
+
+    def __init__(self, patch_size: int = 16, embed_dim: int = 384,
+                 depth: int = 12, num_heads: int = 8, mlp_ratio: float = 4.0,
+                 cls_attn_layers: int = 2, eta: float = 1.0):
+        super().__init__()
+        self.patch_embed = XcitConvPatchEmbed(patch_size, embed_dim)
+        self.pos_embeder = XcitPositionalEncodingFourier(embed_dim)
+        self.cls_token = nn.Parameter(torch.zeros(1, 1, embed_dim))
+        self.blocks = nn.ModuleList(
+            [XcitBlock(embed_dim, num_heads, mlp_ratio, eta)
+             for _ in range(depth)])
+        self.cls_attn_blocks = nn.ModuleList(
+            [XcitClassAttentionBlock(embed_dim, num_heads, mlp_ratio, eta)
+             for _ in range(cls_attn_layers)])
+        self.norm = nn.LayerNorm(embed_dim, eps=1e-6)
+
+    def forward(self, x):
+        b = x.shape[0]
+        x, (hp, wp) = self.patch_embed(x)
+        pos = self.pos_embeder(b, hp, wp).reshape(b, -1, x.shape[1])
+        x = x + pos.permute(0, 2, 1)
+        for blk in self.blocks:
+            x = blk(x, hp, wp)
+        x = torch.cat((self.cls_token.expand(b, -1, -1), x), dim=1)
+        for blk in self.cls_attn_blocks:
+            x = blk(x)
+        return self.norm(x)[:, 0]
+
+
 class TorchVGG16(nn.Module):
     """torchvision vgg16 features + first two classifier linears, exact
     Sequential index naming (features.0..28, classifier.0/.3)."""

@@ -144,3 +144,39 @@ def test_build_backbone_token_features_for_splitloss():
     with pytest.raises(ValueError, match="token"):
         build_backbone("dino", "dino_vits16", jax.random.key(0), None,
                        image_size=32, layer=1, flatten_tokens=True)
+
+
+def test_xcit_archs_registered_and_forward():
+    """The four dino_xcit_* hub entries (reference dino_vits.py:413-487) are
+    selectable via the standard (pt_style, arch) switch and produce CLS
+    embeddings of the published widths at any stride-divisible resolution."""
+    from dcr_tpu.eval.runner import build_backbone
+    from dcr_tpu.models.vit import DINO_ARCHS
+
+    for arch in ("dino_xcit_small_12_p16", "dino_xcit_small_12_p8",
+                 "dino_xcit_medium_24_p16", "dino_xcit_medium_24_p8"):
+        assert arch in DINO_ARCHS
+    small = DINO_ARCHS["dino_xcit_small_12_p16"]()
+    medium = DINO_ARCHS["dino_xcit_medium_24_p8"]()
+    assert (small.embed_dim, small.depth, small.patch_size) == (384, 12, 16)
+    assert (medium.embed_dim, medium.depth, medium.patch_size) == (512, 24, 8)
+
+    f, params = build_backbone("dino", "dino_xcit_small_12_p16",
+                               jax.random.key(0), None, image_size=48)
+    x = jax.random.normal(jax.random.key(1), (2, 48, 48, 3))
+    feats = np.asarray(f(params, x))
+    assert feats.shape == (2, 384)
+    assert np.isfinite(feats).all()
+    # no positional table: a different resolution runs without interpolation
+    y = jax.random.normal(jax.random.key(2), (1, 64, 64, 3))
+    assert np.asarray(f(params, y)).shape == (1, 384)
+
+
+def test_xcit_rejects_intermediate_layer():
+    """--layer is a ViT-only surface in the reference (get_intermediate_layers);
+    XCiT must fail loudly, not silently fall back."""
+    from dcr_tpu.eval.runner import build_backbone
+
+    with pytest.raises(ValueError, match="DINO ViT"):
+        build_backbone("dino", "dino_xcit_small_12_p16", jax.random.key(0),
+                       None, layer=2)

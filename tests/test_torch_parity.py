@@ -233,6 +233,59 @@ def test_vgg16_matches_torch_twin():
                                atol=0.05, rtol=1e-3)
 
 
+@pytest.mark.parametrize("patch_size", [16, 8])
+def test_xcit_matches_torch_twin(patch_size):
+    """XCiT vs an independent torch twin carrying the hub checkpoint naming
+    (reference dino_vits.py:413-487 loads facebookresearch/xcit models).
+    Covers the conv+BN patch tower, Fourier positions, XCA channel attention,
+    depthwise LPI, and the tokens_norm class-attention blocks."""
+    from dcr_tpu.models.convert import convert_xcit
+    from dcr_tpu.models.xcit import XCiT
+    from tests.fixtures.torch_backbones import TorchXCiT
+
+    twin = TorchXCiT(patch_size=patch_size, embed_dim=64, depth=2,
+                     num_heads=4, cls_attn_layers=2, eta=1.0)
+    _randomize(twin, 5 + patch_size)
+    twin.eval()
+    sd = {k: v.numpy() for k, v in twin.state_dict().items()}
+    params = convert_xcit(sd)
+
+    rng = np.random.default_rng(5 + patch_size)
+    img = rng.standard_normal((2, 2 * patch_size, 3 * patch_size, 3)).astype(np.float32)
+    model = XCiT(patch_size=patch_size, embed_dim=64, depth=2, num_heads=4,
+                 cls_attn_layers=2, eta=1.0)
+    ours = model.apply({"params": params}, jnp.asarray(img))
+    with torch.no_grad():
+        theirs = twin(torch.from_numpy(img).permute(0, 3, 1, 2))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               atol=2e-4, rtol=1e-3)
+
+
+def test_xcit_converter_covers_every_twin_weight():
+    """Every tensor in the hub-format state dict must land in the Flax tree
+    (a silently dropped key would mean silently random weights)."""
+    from dcr_tpu.models.convert import check_converted, convert_xcit
+    from dcr_tpu.models.xcit import XCiT
+    from tests.fixtures.torch_backbones import TorchXCiT
+
+    twin = TorchXCiT(patch_size=16, embed_dim=64, depth=2, num_heads=4)
+    sd = {k: v.numpy() for k, v in twin.state_dict().items()}
+    n_stats = sum(1 for k in sd if "running_" in k)
+    params = convert_xcit(sd)
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    # num_batches_tracked buffers are the only state-dict entries without a
+    # Flax destination
+    n_tracked = sum(1 for k in sd if k.endswith("num_batches_tracked"))
+    assert n_leaves == len(sd) - n_tracked, (n_leaves, len(sd), n_tracked)
+    assert n_stats > 0
+
+    model = XCiT(patch_size=16, embed_dim=64, depth=2, num_heads=4)
+    expected = jax.eval_shape(
+        model.init, jax.random.key(0),
+        jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32))["params"]
+    assert check_converted(expected, params) == []
+
+
 def test_sscd_torchscript_file_drop(tmp_path):
     """The SSCD distribution format is a TorchScript archive
     (diff_retrieval.py:277-285). Trace the torch twin, save a real
