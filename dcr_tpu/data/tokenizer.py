@@ -94,6 +94,9 @@ class ClipBPETokenizer(TokenizerBase):
     def __init__(self, vocab_path: str | Path, merges_path: str | Path,
                  model_max_length: int = 77):
         vocab_path, merges_path = Path(vocab_path), Path(merges_path)
+        # kept so trainers can republish the files into their output dir
+        # (the diffusers `tokenizer/` subfolder contract)
+        self.vocab_path, self.merges_path = vocab_path, merges_path
         self.encoder: dict[str, int] = json.loads(vocab_path.read_text())
         merges_text = (gzip.open(merges_path, "rt", encoding="utf-8").read()
                        if merges_path.suffix == ".gz" else merges_path.read_text())

@@ -111,6 +111,14 @@ class Trainer:
             cfg.pretrained_model or None,
             vocab_size=cfg.model.text_vocab_size,
             model_max_length=cfg.model.text_max_length)
+        if self.tokenizer.vocab_size > cfg.model.text_vocab_size:
+            # XLA gathers clamp out-of-range ids instead of failing, so a
+            # too-small embedding table would train silently wrong
+            raise ValueError(
+                f"tokenizer vocab ({self.tokenizer.vocab_size}) exceeds "
+                f"model.text_vocab_size ({cfg.model.text_vocab_size})")
+        if dist.is_primary():
+            self._publish_tokenizer()
         self.dataset = dataset or ObjectAttributeDataset(cfg.data, self.tokenizer)
         # train_batch_size is per-device (reference semantics: per-GPU batch ×
         # num_processes, diff_train.py:556); each process loads for its local chips
@@ -138,6 +146,32 @@ class Trainer:
         self.ckpt = CheckpointManager(self.out_dir / "checkpoints",
                                       max_to_keep=cfg.checkpoints_total_limit)
         self.sample_hook = sample_hook
+
+    def _publish_tokenizer(self) -> None:
+        """Copy BPE vocab/merges into <output_dir>/tokenizer so every
+        downstream stage (dcr-sample/mitigate on --model_path=<run>) picks up
+        the SAME tokenizer automatically — the diffusers checkpoint-dir
+        contract the reference relies on (diff_train.py:370-374)."""
+        import shutil
+
+        paths = (getattr(self.tokenizer, "vocab_path", None),
+                 getattr(self.tokenizer, "merges_path", None))
+        if all(p is not None for p in paths):
+            tok_dir = self.out_dir / "tokenizer"
+            tok_dir.mkdir(parents=True, exist_ok=True)
+            for src, dst in zip(paths, ("vocab.json", "merges.txt")):
+                src = Path(src)
+                if src.resolve() == (tok_dir / dst).resolve():
+                    continue
+                if src.suffix == ".gz":
+                    # republish decompressed — the destination name has no
+                    # .gz, so a verbatim copy would be unreadable downstream
+                    import gzip
+
+                    (tok_dir / dst).write_text(
+                        gzip.open(src, "rt", encoding="utf-8").read())
+                else:
+                    shutil.copyfile(src, tok_dir / dst)
 
     # -- checkpoint/resume ---------------------------------------------------
 

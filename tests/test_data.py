@@ -300,3 +300,56 @@ def test_loader_no_leaked_worker_threads(image_folder):
     it.close()  # triggers the generator's finally
     time.sleep(0.3)
     assert threading.active_count() <= before + 1
+
+
+def test_committed_bpe_fixture_is_real_format():
+    """tests/fixtures/bpe holds a LEARNED byte-level BPE table in CLIP's exact
+    file format (256 byte symbols + 256 word-final symbols + merges in rank
+    order + specials; '#version' merges header) — regenerable with
+    tools/gen_bpe_fixture.py. Guards the fixture against drift and exercises
+    real-BPE truncation, which HashTokenizer can't."""
+    from pathlib import Path
+
+    from dcr_tpu.data.tokenizer import ClipBPETokenizer, load_tokenizer
+
+    fix = Path(__file__).parent / "fixtures" / "bpe"
+    assert (fix / "merges.txt").read_text().startswith("#version:")
+    tok = load_tokenizer(fix)
+    assert isinstance(tok, ClipBPETokenizer)
+    vocab = json.loads((fix / "vocab.json").read_text())
+    merges = [l for l in (fix / "merges.txt").read_text().splitlines()[1:] if l]
+    assert len(vocab) == 512 + len(merges) + 2
+    assert vocab["<|endoftext|>"] == len(vocab) - 1
+
+    # corpus words merge to single tokens; every id is in range
+    ids = tok.encode("an image of garbage truck")
+    assert len(ids) == 5
+    assert all(0 <= i < tok.vocab_size for i in ids)
+    assert tok.decode(ids) == "an image of garbage truck"
+
+    # real truncation: a caption longer than the context clips to 77 with
+    # BOS first and EOS present (reference datasets.py:144-150 semantics)
+    long_caption = " ".join(["unmergeablewordxyz"] * 40)
+    batch = tok(long_caption)
+    assert batch.shape == (1, 77)
+    assert batch[0, 0] == tok.bos_token_id
+    assert batch[0, -1] == tok.eos_token_id  # truncated -> EOS is the cap
+
+
+def test_instancelevel_random_through_real_bpe(image_folder):
+    """The token-id decode path (reference datasets.py:140-142) through the
+    REAL BPE decoder: ids -> text -> re-encode stays in-vocab."""
+    from pathlib import Path
+
+    from dcr_tpu.data.tokenizer import load_tokenizer
+
+    tok = load_tokenizer(Path(__file__).parent / "fixtures" / "bpe")
+    root, _ = image_folder
+    paths, _, _ = list_image_folder(root)
+    rng = np.random.default_rng(3)
+    caps = {p: [str([int(i) for i in rng.integers(1, 500, 4)])] for p in paths}
+    cfg = _cfg(root, class_prompt="instancelevel_random")
+    ds = ObjectAttributeDataset(cfg, tok, caption_tables=caps)
+    ex = ds.get(0)
+    assert ex.input_ids.shape == (77,)
+    assert ex.input_ids.max() < tok.vocab_size

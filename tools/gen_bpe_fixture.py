@@ -1,0 +1,118 @@
+"""Generate a small REAL-FORMAT CLIP-BPE vocab.json + merges.txt fixture.
+
+The reference tokenizes through HF CLIPTokenizer (diff_train.py:370-374,
+datasets.py:144-150); its vocab/merges files can't be downloaded here (zero
+egress), so this script *learns* a compact merge table with the standard BPE
+training algorithm (Sennrich et al. 2016 — the same procedure that produced
+the real CLIP files) over the framework's own caption corpus: imagenette
+classnames, the caption templates, and the 12 known-replication prompts.
+
+The output is byte-level BPE in exactly CLIP's file format —
+  vocab.json : {symbol: id} with 256 byte symbols, 256 "</w>" word-final
+               byte symbols, the learned merges in rank order, then
+               <|startoftext|> / <|endoftext|>
+  merges.txt : "#version: 0.2" header + one "left right" pair per rank
+— so ClipBPETokenizer (and HF CLIPTokenizer, where installed) loads it
+unchanged. Deterministic: re-running reproduces the committed fixture.
+
+Usage: python tools/gen_bpe_fixture.py [out_dir]  (default tests/fixtures/bpe)
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import re
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dcr_tpu.cli.mitigate import KNOWN_REPLICATION_PROMPTS
+from dcr_tpu.data.captions import IMAGENETTE_CLASSES
+from dcr_tpu.data.tokenizer import ClipBPETokenizer, _bytes_to_unicode
+
+N_MERGES = 384
+
+
+def corpus() -> list[str]:
+    # class templates are weighted like a real caption table (every image in a
+    # class repeats them), so classnames + template words merge to single
+    # tokens; one-off prompt words stay multi-token — the realistic mix
+    texts = 50 * ["An image", "An image of"]
+    texts += 50 * [f"An image of {c}" for c in IMAGENETTE_CLASSES]
+    texts += list(KNOWN_REPLICATION_PROMPTS)
+    # common caption filler so BLIP-style captions tokenize compactly too
+    texts += 10 * ["a photo of a", "a close up of a", "a painting of a",
+                   "on a table", "in the background", "black and white",
+                   "a man standing next to a", "a woman sitting on a",
+                   "a group of people", "red blue green yellow"]
+    return texts
+
+
+def word_freqs(texts: list[str]) -> collections.Counter:
+    b2u = _bytes_to_unicode()
+    freqs: collections.Counter = collections.Counter()
+    for text in texts:
+        for word in re.findall(ClipBPETokenizer.PAT, text.lower()):
+            sym = "".join(b2u[b] for b in word.encode("utf-8"))
+            word_t = tuple(sym[:-1]) + (sym[-1] + "</w>",)
+            freqs[word_t] += 1
+    return freqs
+
+
+def learn_merges(freqs: collections.Counter, n: int) -> list[tuple[str, str]]:
+    merges: list[tuple[str, str]] = []
+    for _ in range(n):
+        pairs: collections.Counter = collections.Counter()
+        for word, f in freqs.items():
+            for i in range(len(word) - 1):
+                pairs[(word[i], word[i + 1])] += f
+        if not pairs:
+            break
+        # deterministic argmax: highest count, ties by pair order
+        best = max(sorted(pairs), key=lambda p: pairs[p])
+        if pairs[best] < 2:
+            break
+        merges.append(best)
+        merged = best[0] + best[1]
+        new_freqs: collections.Counter = collections.Counter()
+        for word, f in freqs.items():
+            out, i = [], 0
+            while i < len(word):
+                if (i < len(word) - 1 and word[i] == best[0]
+                        and word[i + 1] == best[1]):
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(word[i])
+                    i += 1
+            new_freqs[tuple(out)] += f
+        freqs = new_freqs
+    return merges
+
+
+def main(out_dir: str | Path = "tests/fixtures/bpe") -> None:
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    merges = learn_merges(word_freqs(corpus()), N_MERGES)
+
+    b2u = _bytes_to_unicode()
+    symbols = [b2u[b] for b in range(256)]
+    vocab = symbols + [s + "</w>" for s in symbols]
+    vocab += [a + b for a, b in merges]
+    vocab += ["<|startoftext|>", "<|endoftext|>"]
+    (out / "vocab.json").write_text(
+        json.dumps({s: i for i, s in enumerate(vocab)}, ensure_ascii=False))
+    (out / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges) + "\n")
+    print(f"wrote {out}/vocab.json ({len(vocab)} entries) and "
+          f"{out}/merges.txt ({len(merges)} merges)")
+
+    tok = ClipBPETokenizer(out / "vocab.json", out / "merges.txt")
+    ids = tok("An image of garbage truck")[0]
+    print("round-trip:", repr(tok.decode(ids)))
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])
