@@ -500,6 +500,103 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
     return result
 
 
+def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
+                      resolution: int = 256,
+                      synthetic_step_ms: float | None = None) -> dict:
+    """Train from a REAL image folder through DataLoader + the native scaled
+    JPEG decode — the loader-in-context rung (VERDICT r4 #5). Reports
+    images/sec/chip plus the loader-stall fraction (host time spent waiting
+    on batches ÷ wall time) and, when the synthetic rung at the same bs is
+    available, whether the host kept the chip fed (≤5% slowdown)."""
+    import numpy as np
+
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.data.dataset import ObjectAttributeDataset
+    from dcr_tpu.data.loader import DataLoader
+    from dcr_tpu.data.tokenizer import HashTokenizer
+    from dcr_tpu.diffusion import train as T
+    from dcr_tpu.diffusion.trainer import build_models
+    from dcr_tpu.parallel import mesh as pmesh
+
+    n_dev = len(jax.devices())
+    bsz = batch_size * n_dev
+    # cached photographic-ish corpus (tools/bench_loader.make_corpus), 512px
+    # source so 256px targets exercise the scaled-decode fast path
+    sys.path.insert(0, str(Path(__file__).resolve().parent / "tools"))
+    from bench_loader import make_corpus
+
+    corpus = Path(__file__).resolve().parent / ".bench_corpus_512"
+    cls_dir = corpus / "c0"             # dataset layout wants class subdirs
+    n_images = max(2 * bsz, 64)
+    have = list(cls_dir.glob("*.jpg")) if cls_dir.is_dir() else []
+    if len(have) < n_images:
+        cls_dir.mkdir(parents=True, exist_ok=True)
+        make_corpus(cls_dir, n_images, 512)
+    mark("loader_corpus", n=n_images, px_src=512)
+
+    cfg = _make_cfg(batch_size, resolution, False, True)
+    cfg.data.train_data_dir = str(corpus)
+    cfg.data.class_prompt = "nolevel"
+    cfg.data.num_workers = max(2, (os.cpu_count() or 4) - 2)
+    mesh = pmesh.make_mesh(cfg.mesh)
+    models, params = build_models(cfg, jax.random.key(0), mesh=mesh)
+    state = T.init_train_state(cfg, models, unet_params=params["unet"],
+                               text_params=params["text"], vae_params=params["vae"])
+    state = T.shard_train_state(state, mesh)
+    step_fn = T.make_train_step(cfg, models, mesh)
+    dataset = ObjectAttributeDataset(
+        cfg.data, HashTokenizer(cfg.model.text_vocab_size,
+                                cfg.model.text_max_length))
+    loader = DataLoader(dataset, batch_size=bsz,
+                        num_workers=cfg.data.num_workers, seed=0)
+    key = rngmod.root_key(0)
+
+    def batches():
+        epoch = 0
+        while True:
+            yield from loader.epoch(epoch)
+            epoch += 1
+
+    it = batches()
+    m = None
+
+    def run(n: int) -> tuple[float, float]:
+        """(wall seconds, loader-wait seconds) for n fetch+step iterations
+        ending in one loss fetch — the same slope-method window shape as
+        bench_rung, so the ~RTT of the final sync cancels in (t(1+N)−t(1))/N.
+        Loader wait times ONLY next(it); shard_batch H2D stays out of it."""
+        nonlocal state, m
+        wait = 0.0
+        t0 = time.perf_counter()
+        for _ in range(n):
+            tf = time.perf_counter()
+            b = next(it)
+            wait += time.perf_counter() - tf
+            state, m = step_fn(state, pmesh.shard_batch(mesh, dict(b)), key)
+        float(jax.device_get(m["loss"]))
+        return time.perf_counter() - t0, wait
+
+    dog.rearm()
+    run(2)                                     # compile + loader spin-up
+    dog.rearm()
+    t1, w1 = run(1)
+    tn, wn = run(1 + steps)
+    dt = max(tn - t1, 1e-9) / steps
+    stall = max(wn - w1, 0.0) / steps
+    imgs = bsz / dt / n_dev
+    result = {"bs": batch_size, "px": resolution, "source": "loader",
+              "images_per_sec_per_chip": round(imgs, 3),
+              "step_ms": round(dt * 1e3, 1),
+              "loader_stall_fraction": round(stall / dt, 4),
+              "num_workers": cfg.data.num_workers,
+              "loss": round(float(m["loss"]), 4)}
+    if synthetic_step_ms:
+        result["synthetic_step_ms"] = synthetic_step_ms
+        result["kept_fed"] = bool(dt * 1e3 <= synthetic_step_ms * 1.05)
+    mark("loader_rung_done", **result)
+    return result
+
+
 def bench_512(jax, dog: Watchdog, t_start: float, budget: float) -> dict | None:
     """In-context flash demonstration (round-2 verdict item 2): one 512px
     train rung with the Pallas flash kernel on vs off. At 512px the UNet's
@@ -573,6 +670,7 @@ def main() -> None:
         ladder = [int(b) for b in os.environ["BENCH_BS"].split(",")]
     best = None
     err = None
+    ladder_results: list = []
     from collections import deque
 
     queue = deque(ladder)
@@ -584,6 +682,7 @@ def main() -> None:
         dog.rearm()
         try:
             result = bench_rung(jax, bs, dog)
+            ladder_results.append(result)
             if best is None or result["images_per_sec_per_chip"] > best["images_per_sec_per_chip"]:
                 best = result
                 _banked_best[0] = result   # a later hang must still emit this
@@ -610,6 +709,22 @@ def main() -> None:
                 _banked_best[0] = result
         except Exception as e:
             mark("rung_failed", bs=32, remat=True, error=repr(e)[:500])
+    # loader-fed rung — additive, never touches `best`: same train step, but
+    # batches come from a real image folder through DataLoader + native
+    # decode, answering "does the host keep the chip fed at bs=16?"
+    loader_rung = None
+    if (best is not None and os.environ.get("BENCH_LOADER", "1") != "0"
+            and not os.environ.get("BENCH_BS")
+            and time.time() - t_start < budget):
+        dog.rearm()
+        try:
+            ref = next((r for r in ladder_results
+                        if r["bs"] == 16 and r["px"] == 256), None)
+            loader_rung = bench_loader_rung(
+                jax, 16, dog,
+                synthetic_step_ms=ref["step_ms"] if ref else None)
+        except Exception as e:
+            mark("rung_failed", source="loader", error=repr(e)[:500])
     # 512px flash-in-context pair — additive, never touches `best` (the
     # headline metric stays the 256px reference workload)
     flash512 = None
@@ -625,7 +740,8 @@ def main() -> None:
                               exit_code=3)
     out = _result_line(best["images_per_sec_per_chip"])
     mark("done", mfu=best["mfu"], bs=best["bs"], step_ms=best["step_ms"],
-         flops_method=best["flops_method"], flash512=flash512)
+         flops_method=best["flops_method"], flash512=flash512,
+         loader=loader_rung)
     print(json.dumps(out))
 
 
