@@ -166,6 +166,16 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
         gen_dir.mkdir(parents=True, exist_ok=True)
         save_prompts(prompts, savepath)
 
+    # place params on the mesh: tensor-axis meshes shard the big matmul
+    # weights Megatron-style (same rules as training), fsdp axes shard by
+    # largest-divisible-dim, anything else replicates — so a model too big
+    # for one chip's HBM samples across chips without code changes
+    from dcr_tpu.parallel.sharding import params_sharding
+
+    tensor_parallel = mesh.shape.get(pmesh.TENSOR_AXIS, 1) > 1
+    params = jax.device_put(
+        params, params_sharding(mesh, params, tensor_parallel=tensor_parallel))
+
     sampler = make_sampler(cfg, models, mesh)
     uncond_ids = tokenizer([""])[0]
     key = rngmod.root_key(cfg.seed)
