@@ -34,6 +34,7 @@ from dcr_tpu.models.clip_text import CLIPTextModel
 from dcr_tpu.models.unet2d import UNet2DCondition
 from dcr_tpu.models.vae import AutoencoderKL
 from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.parallel.sharding import params_sharding
 from dcr_tpu.sampling.prompts import build_prompt_list, save_prompts
 from dcr_tpu.sampling.sampler import make_sampler
 
@@ -170,8 +171,6 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
     # weights Megatron-style (same rules as training), fsdp axes shard by
     # largest-divisible-dim, anything else replicates — so a model too big
     # for one chip's HBM samples across chips without code changes
-    from dcr_tpu.parallel.sharding import params_sharding
-
     tensor_parallel = mesh.shape.get(pmesh.TENSOR_AXIS, 1) > 1
     params = jax.device_put(
         params, params_sharding(mesh, params, tensor_parallel=tensor_parallel))

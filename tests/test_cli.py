@@ -306,3 +306,23 @@ def test_trainer_rejects_tokenizer_vocab_overflow(tmp_path, cpu_devices):
     cfg.model.text_vocab_size = 64          # < fixture's 668
     with pytest.raises(ValueError, match="vocab"):
         Trainer(cfg)
+
+
+def test_cli_evaluate_with_xcit_arch(tmp_path, cpu_devices):
+    """`--pt_style dino --arch dino_xcit_small_12_p16` through the evaluate
+    CLI (the reference's hub-constructor selection, dino_vits.py:413-487) —
+    the XCiT family is a first-class eval backbone, not just a registry entry."""
+    from dcr_tpu.cli import evaluate as cli_evaluate
+
+    _images(tmp_path / "gens", 2, seed=41, size=32)
+    _images(tmp_path / "data" / "c0", 4, seed=42, size=32)
+    plots = tmp_path / "plots"
+    cli_evaluate.main([
+        f"--query_dir={tmp_path / 'gens'}", f"--values_dir={tmp_path / 'data'}",
+        "--pt_style=dino", "--arch=dino_xcit_small_12_p16", "--batch_size=2",
+        "--image_size=32", "--compute_fid=false", "--compute_clip_score=false",
+        "--compute_complexity=false", "--galleries=false",
+        f"--output_dir={plots}"])
+    sim = np.load(plots / "similarity.npy")
+    assert sim.shape == (2, 4)
+    assert np.isfinite(sim).all()

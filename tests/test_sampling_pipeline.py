@@ -83,6 +83,8 @@ def test_generate_with_tensor_parallel_mesh(exported_ckpt, tmp_path, cpu_devices
     assert len(dp) == len(tp) == 4
     for a, b in zip(dp, tp):
         with Image.open(a) as ia, Image.open(b) as ib:
-            # bitwise-equal after uint8 quantization: TP changes the compute
-            # partitioning, not the math
-            np.testing.assert_array_equal(np.asarray(ia), np.asarray(ib))
+            # TP changes compute partitioning, not the math; allow 1 uint8
+            # LSB for reduction-order float drift at rounding boundaries
+            diff = np.abs(np.asarray(ia).astype(np.int16)
+                          - np.asarray(ib).astype(np.int16))
+            assert diff.max() <= 1, f"max pixel diff {diff.max()}"
