@@ -33,6 +33,9 @@ cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
 run sweep     2400 python tools/sweep_flash.py
 run crosscheck 1800 python tools/check_flash_timing.py
 run sample    1800 python tools/bench_sample.py
+# trace is additive diagnostics (never the number of record — tracing
+# perturbs timing); a wedge here must not eat the banked results above
+run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}${TAG}"
 
 echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"
 echo "commit the snapshot + SWEEP_FLASH.jsonl + CHECK_FLASH_TIMING.jsonl +"

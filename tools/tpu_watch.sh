@@ -36,9 +36,11 @@ EOF
   if [ "$rc" -eq 0 ] && [[ "$out" == tpu:* ]] && [ "$FIRED" -eq 0 ]; then
     FIRED=1
     echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"tpu_alive_firing_measure_all\"}" >> "$LOG"
-    # bounded: if the backend flaps back into the hang mid-measure, the
-    # watcher must return to probing, not block forever
-    timeout 7200 env ROUND="$ROUND" TAG=w bash tools/measure_all.sh
+    # bounded above the sum of measure_all's own stage budgets (~12300s), so
+    # it only fires on a true wedge — a healthy window always completes; on
+    # a wedge, reap any orphaned stage so the next probes see a free backend
+    timeout 14400 env ROUND="$ROUND" TAG=w bash tools/measure_all.sh \
+      || pkill -f "bench.py|sweep_flash|check_flash_timing|bench_sample|capture_profile"
     echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\"}" >> "$LOG"
   fi
   sleep "$PROBE_INTERVAL"
