@@ -27,18 +27,15 @@ def main(steps: int = 4, batch_size: int = 16,
     import jax
     import numpy as np
 
+    from bench import _make_cfg  # the bench workload IS the traced workload
     from dcr_tpu.core import rng as rngmod
-    from dcr_tpu.core.config import ModelConfig, TrainConfig
     from dcr_tpu.diffusion import train as T
     from dcr_tpu.diffusion.trainer import build_models
     from dcr_tpu.parallel import mesh as pmesh
 
     devs = jax.devices()
     print(f"devices: {devs}")
-    cfg = TrainConfig(mixed_precision="bf16", train_batch_size=batch_size)
-    cfg.data.resolution = 256
-    cfg.model = ModelConfig(sample_size=32, flash_attention=True)
-    cfg.optim.lr_warmup_steps = 0
+    cfg = _make_cfg(batch_size, 256, False, True)
 
     mesh = pmesh.make_mesh(cfg.mesh)
     models, params = build_models(cfg, jax.random.key(0), mesh=mesh)

@@ -37,11 +37,29 @@ EOF
     FIRED=1
     echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"tpu_alive_firing_measure_all\"}" >> "$LOG"
     # bounded above the sum of measure_all's own stage budgets (~12300s), so
-    # it only fires on a true wedge — a healthy window always completes; on
-    # a wedge, reap any orphaned stage so the next probes see a free backend
-    timeout 14400 env ROUND="$ROUND" TAG=w bash tools/measure_all.sh \
-      || pkill -f "bench.py|sweep_flash|check_flash_timing|bench_sample|capture_profile"
-    echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\"}" >> "$LOG"
+    # it only fires on a true wedge — a healthy window always completes. The
+    # run gets its own process group (setsid) so wedge cleanup kills exactly
+    # this tree, never an unrelated bench.py (e.g. the driver's own run).
+    ROUND="$ROUND" TAG=w setsid bash tools/measure_all.sh &
+    ma=$!
+    t0=$SECONDS
+    wedged=0
+    while kill -0 "$ma" 2>/dev/null; do
+      if (( SECONDS - t0 > 14400 )); then
+        kill -TERM -- "-$ma" 2>/dev/null
+        sleep 10
+        kill -KILL -- "-$ma" 2>/dev/null
+        echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_wedged_killed\"}" >> "$LOG"
+        wedged=1
+        FIRED=0    # a wedged run banked nothing — retry on the next live probe
+        break
+      fi
+      sleep 30
+    done
+    wait "$ma" 2>/dev/null
+    if [ "$wedged" -eq 0 ]; then
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\"}" >> "$LOG"
+    fi
   fi
   sleep "$PROBE_INTERVAL"
 done
