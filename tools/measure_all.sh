@@ -23,12 +23,15 @@ run() { # name timeout_s cmd...
   local name="$1" t="$2"; shift 2
   echo "=== $name (timeout ${t}s) $(date +%H:%M:%S) ===" | tee -a "$LOG"
   timeout "$t" "$@" >> "$LOG" 2>&1
-  echo "=== $name rc=$? $(date +%H:%M:%S) ===" | tee -a "$LOG"
+  local rc=$?
+  echo "=== $name rc=$rc $(date +%H:%M:%S) ===" | tee -a "$LOG"
+  return "$rc"
 }
 
 # manual window: no driver kill looming, so give the ladder its full room
 # (the in-repo defaults are sized for the driver's ~30min window)
 run bench     5400 env BENCH_TIME_BUDGET_SECS=4800 BENCH_TIMEOUT_SECS=2400 python bench.py
+BENCH_RC=$?
 cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
 run sweep     2400 python tools/sweep_flash.py
 run crosscheck 1800 python tools/check_flash_timing.py
@@ -40,3 +43,6 @@ run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}
 echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"
 echo "commit the snapshot + SWEEP_FLASH.jsonl + CHECK_FLASH_TIMING.jsonl +"
 echo "BENCH_SAMPLE.jsonl and update BASELINE.md from them."
+# the bench ladder is the stage of record: propagate its failure so callers
+# (tools/tpu_watch.sh) know nothing was banked and re-arm for the next window
+exit "$BENCH_RC"

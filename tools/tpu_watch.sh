@@ -57,8 +57,14 @@ EOF
       sleep 30
     done
     wait "$ma" 2>/dev/null
-    if [ "$wedged" -eq 0 ]; then
+    ma_rc=$?
+    if [ "$wedged" -eq 0 ] && [ "$ma_rc" -eq 0 ]; then
       echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\"}" >> "$LOG"
+    elif [ "$wedged" -eq 0 ]; then
+      # fast failure (e.g. the backend flapped back down mid-run): banked
+      # nothing, so re-arm for the next live window and say so in the log
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_failed\", \"rc\": $ma_rc}" >> "$LOG"
+      FIRED=0
     fi
   fi
   sleep "$PROBE_INTERVAL"
