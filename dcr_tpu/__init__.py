@@ -30,6 +30,9 @@ _PUBLIC = {
     "generate": "dcr_tpu.sampling.pipeline",
     "run_eval": "dcr_tpu.eval.runner",
     "make_mesh": "dcr_tpu.parallel.mesh",
+    "build_backbone": "dcr_tpu.eval.runner",
+    "DINO_ARCHS": "dcr_tpu.models.vit",
+    "load_tokenizer": "dcr_tpu.data.tokenizer",
     "flash_attention": "dcr_tpu.ops.flash_attention",
     "ring_self_attention": "dcr_tpu.ops.ring_attention",
     "ulysses_self_attention": "dcr_tpu.ops.ulysses_attention",

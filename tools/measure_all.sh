@@ -13,10 +13,13 @@
 # for committing (the raw artifact BASELINE.md cites).
 #
 # Usage: ROUND=4 TAG=a bash tools/measure_all.sh
+#        ONLY=bench ... runs just the bench ladder (retry of the stage of
+#        record without redundantly re-running already-banked stages)
 set -u
 cd "$(dirname "$0")/.."
 ROUND="${ROUND:-4}"
 TAG="${TAG:-a}"
+ONLY="${ONLY:-}"
 LOG="measure_all_r${ROUND}${TAG}.log"
 
 run() { # name timeout_s cmd...
@@ -33,16 +36,19 @@ run() { # name timeout_s cmd...
 run bench     5400 env BENCH_TIME_BUDGET_SECS=4800 BENCH_TIMEOUT_SECS=2400 python bench.py
 BENCH_RC=$?
 cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
-run sweep     2400 python tools/sweep_flash.py
-run crosscheck 1800 python tools/check_flash_timing.py
-run sample    1800 python tools/bench_sample.py
-# trace is additive diagnostics (never the number of record — tracing
-# perturbs timing); a wedge here must not eat the banked results above
-run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}${TAG}"
+if [ "$ONLY" != "bench" ]; then
+  run sweep     2400 python tools/sweep_flash.py
+  run crosscheck 1800 python tools/check_flash_timing.py
+  run sample    1800 python tools/bench_sample.py
+  # trace is additive diagnostics (never the number of record — tracing
+  # perturbs timing); a wedge here must not eat the banked results above
+  run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}${TAG}"
+fi
 
 echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"
 echo "commit the snapshot + SWEEP_FLASH.jsonl + CHECK_FLASH_TIMING.jsonl +"
 echo "BENCH_SAMPLE.jsonl and update BASELINE.md from them."
 # the bench ladder is the stage of record: propagate its failure so callers
-# (tools/tpu_watch.sh) know nothing was banked and re-arm for the next window
+# (tools/tpu_watch.sh) can retry it — later stages bank their own artifacts
+# regardless, so a retry should use ONLY=bench
 exit "$BENCH_RC"

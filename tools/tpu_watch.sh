@@ -8,8 +8,13 @@
 #     timeout and append one JSON line {ts, rc, secs, devices} to
 #     TPU_PROBE_r${ROUND}.jsonl  (rc=124/143 → hang, the outage signature)
 #   * the moment a probe answers with a real TPU device, fire
-#     tools/measure_all.sh once to bank the full measurement ladder, then
-#     keep probing (so the log also shows how long the window stayed open)
+#     tools/measure_all.sh to bank the full measurement ladder, then keep
+#     probing (so the log also shows how long the window stayed open)
+#   * a failed/wedged run re-arms so a later healthy window still gets
+#     measured — retries run ONLY=bench (the stage of record; the other
+#     stages bank their own artifacts on the first pass) with distinct
+#     TAGs so no snapshot is overwritten, capped at MAX_FIRES total so a
+#     deterministic fast failure can't churn the machine forever
 #
 # Usage: ROUND=5 nohup bash tools/tpu_watch.sh &
 set -u
@@ -18,7 +23,9 @@ ROUND="${ROUND:-5}"
 LOG="TPU_PROBE_r${ROUND}.jsonl"
 PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
 PROBE_TIMEOUT="${PROBE_TIMEOUT:-120}"
+MAX_FIRES="${MAX_FIRES:-3}"
 FIRED=0
+FIRES=0
 
 while true; do
   ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
@@ -33,14 +40,20 @@ EOF
   secs=$((SECONDS - t0))
   printf '{"ts": "%s", "rc": %d, "secs": %d, "devices": "%s"}\n' \
     "$ts" "$rc" "$secs" "${out:-}" >> "$LOG"
-  if [ "$rc" -eq 0 ] && [[ "$out" == tpu:* ]] && [ "$FIRED" -eq 0 ]; then
+  if [ "$rc" -eq 0 ] && [[ "$out" == tpu:* ]] && [ "$FIRED" -eq 0 ] \
+      && [ "$FIRES" -lt "$MAX_FIRES" ]; then
     FIRED=1
-    echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"tpu_alive_firing_measure_all\"}" >> "$LOG"
+    FIRES=$((FIRES + 1))
+    only=""
+    if [ "$FIRES" -gt 1 ]; then
+      only="bench"      # retries re-run only the stage of record
+    fi
+    echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"tpu_alive_firing_measure_all\", \"attempt\": $FIRES, \"only\": \"$only\"}" >> "$LOG"
     # bounded above the sum of measure_all's own stage budgets (~12300s), so
     # it only fires on a true wedge — a healthy window always completes. The
     # run gets its own process group (setsid) so wedge cleanup kills exactly
     # this tree, never an unrelated bench.py (e.g. the driver's own run).
-    ROUND="$ROUND" TAG=w setsid bash tools/measure_all.sh &
+    ROUND="$ROUND" TAG="w$FIRES" ONLY="$only" setsid bash tools/measure_all.sh &
     ma=$!
     t0=$SECONDS
     wedged=0
@@ -49,9 +62,9 @@ EOF
         kill -TERM -- "-$ma" 2>/dev/null
         sleep 10
         kill -KILL -- "-$ma" 2>/dev/null
-        echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_wedged_killed\"}" >> "$LOG"
+        echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_wedged_killed\", \"attempt\": $FIRES}" >> "$LOG"
         wedged=1
-        FIRED=0    # a wedged run banked nothing — retry on the next live probe
+        FIRED=0    # a wedged run banked no bench number — retry next window
         break
       fi
       sleep 30
@@ -59,12 +72,15 @@ EOF
     wait "$ma" 2>/dev/null
     ma_rc=$?
     if [ "$wedged" -eq 0 ] && [ "$ma_rc" -eq 0 ]; then
-      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\"}" >> "$LOG"
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_done\", \"attempt\": $FIRES}" >> "$LOG"
     elif [ "$wedged" -eq 0 ]; then
-      # fast failure (e.g. the backend flapped back down mid-run): banked
-      # nothing, so re-arm for the next live window and say so in the log
-      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_failed\", \"rc\": $ma_rc}" >> "$LOG"
+      # the bench stage of record failed (other stages bank independently):
+      # re-arm for the next live window and say so in the log
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"measure_all_failed\", \"rc\": $ma_rc, \"attempt\": $FIRES}" >> "$LOG"
       FIRED=0
+    fi
+    if [ "$FIRED" -eq 0 ] && [ "$FIRES" -ge "$MAX_FIRES" ]; then
+      echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"retry_cap_reached\", \"fires\": $FIRES}" >> "$LOG"
     fi
   fi
   sleep "$PROBE_INTERVAL"
