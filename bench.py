@@ -383,24 +383,32 @@ def flops_cpu_hlo(jax, batch_size: int, resolution: int) -> float:
     return flops * (batch_size / ref_bs)
 
 
+def _build_train_state(jax, cfg):
+    """(mesh, sharded state, step_fn) for a bench config — the setup block
+    shared by the synthetic rungs and the loader-fed rung."""
+    from dcr_tpu.diffusion import train as T
+    from dcr_tpu.diffusion.trainer import build_models
+    from dcr_tpu.parallel import mesh as pmesh
+
+    mesh = pmesh.make_mesh(cfg.mesh)
+    models, params = build_models(cfg, jax.random.key(0), mesh=mesh)
+    state = T.init_train_state(cfg, models, unet_params=params["unet"],
+                               text_params=params["text"], vae_params=params["vae"])
+    state = T.shard_train_state(state, mesh)
+    return mesh, state, T.make_train_step(cfg, models, mesh)
+
+
 def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
                remat: bool = False, resolution: int = 256,
                flash: bool = True) -> dict:
     import numpy as np
 
     from dcr_tpu.core import rng as rngmod
-    from dcr_tpu.diffusion import train as T
-    from dcr_tpu.diffusion.trainer import build_models
     from dcr_tpu.parallel import mesh as pmesh
     from dcr_tpu.utils import profiling
 
     cfg = _make_cfg(batch_size, resolution, remat, flash)
-    mesh = pmesh.make_mesh(cfg.mesh)
-    models, params = build_models(cfg, jax.random.key(0), mesh=mesh)
-    state = T.init_train_state(cfg, models, unet_params=params["unet"],
-                               text_params=params["text"], vae_params=params["vae"])
-    state = T.shard_train_state(state, mesh)
-    step_fn = T.make_train_step(cfg, models, mesh)
+    mesh, state, step_fn = _build_train_state(jax, cfg)
     mark("state_built", bs=batch_size, px=resolution, flash=flash,
          params_m=round(sum(x.size for x in jax.tree.leaves(state.unet_params)) / 1e6))
 
@@ -514,8 +522,6 @@ def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
     from dcr_tpu.data.dataset import ObjectAttributeDataset
     from dcr_tpu.data.loader import DataLoader
     from dcr_tpu.data.tokenizer import HashTokenizer
-    from dcr_tpu.diffusion import train as T
-    from dcr_tpu.diffusion.trainer import build_models
     from dcr_tpu.parallel import mesh as pmesh
 
     n_dev = len(jax.devices())
@@ -538,12 +544,7 @@ def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
     cfg.data.train_data_dir = str(corpus)
     cfg.data.class_prompt = "nolevel"
     cfg.data.num_workers = max(2, (os.cpu_count() or 4) - 2)
-    mesh = pmesh.make_mesh(cfg.mesh)
-    models, params = build_models(cfg, jax.random.key(0), mesh=mesh)
-    state = T.init_train_state(cfg, models, unet_params=params["unet"],
-                               text_params=params["text"], vae_params=params["vae"])
-    state = T.shard_train_state(state, mesh)
-    step_fn = T.make_train_step(cfg, models, mesh)
+    mesh, state, step_fn = _build_train_state(jax, cfg)
     dataset = ObjectAttributeDataset(
         cfg.data, HashTokenizer(cfg.model.text_vocab_size,
                                 cfg.model.text_max_length))
@@ -579,15 +580,30 @@ def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
     dog.rearm()
     run(2)                                     # compile + loader spin-up
     dog.rearm()
-    t1, w1 = run(1)
-    tn, wn = run(1 + steps)
-    dt = max(tn - t1, 1e-9) / steps
-    stall = max(wn - w1, 0.0) / steps
+    # min-of-2 like bench_rung: a single t(1) sample can land on a prefetch
+    # backlog (its one fetch waits while the queue refills) and overestimate
+    # per-step cost so badly the slope goes negative
+    t1, w1 = min(run(1) for _ in range(2))
+    tn, wn = min(run(1 + steps) for _ in range(2))
+    if tn - t1 > 1e-3:
+        dt = (tn - t1) / steps
+        method = "slope"
+        stall_frac = min(max(wn - w1, 0.0) / steps / dt, 1.0)
+    else:
+        # degenerate slope (loader-wait variance swamped the signal): fall
+        # back to total wall over the long window — includes one sync RTT,
+        # so it can only OVERstate step time / understate throughput. The
+        # stall fraction must come from the SAME window (wn over tn), not
+        # the slope pair the fallback just judged unusable.
+        dt = tn / (1 + steps)
+        method = "total"
+        stall_frac = min(wn / tn, 1.0)
     imgs = bsz / dt / n_dev
     result = {"bs": batch_size, "px": resolution, "source": "loader",
               "images_per_sec_per_chip": round(imgs, 3),
               "step_ms": round(dt * 1e3, 1),
-              "loader_stall_fraction": round(stall / dt, 4),
+              "timing_method": method,
+              "loader_stall_fraction": round(stall_frac, 4),
               "num_workers": cfg.data.num_workers,
               "loss": round(float(m["loss"]), 4)}
     if synthetic_step_ms:

@@ -27,23 +27,15 @@ def main(steps: int = 4, batch_size: int = 16,
     import jax
     import numpy as np
 
-    from bench import _make_cfg  # the bench workload IS the traced workload
+    # the bench workload IS the traced workload: same config and same setup
+    from bench import _build_train_state, _make_cfg
     from dcr_tpu.core import rng as rngmod
-    from dcr_tpu.diffusion import train as T
-    from dcr_tpu.diffusion.trainer import build_models
     from dcr_tpu.parallel import mesh as pmesh
 
     devs = jax.devices()
     print(f"devices: {devs}")
     cfg = _make_cfg(batch_size, 256, False, True)
-
-    mesh = pmesh.make_mesh(cfg.mesh)
-    models, params = build_models(cfg, jax.random.key(0), mesh=mesh)
-    state = T.init_train_state(cfg, models, unet_params=params["unet"],
-                               text_params=params["text"],
-                               vae_params=params["vae"])
-    state = T.shard_train_state(state, mesh)
-    step_fn = T.make_train_step(cfg, models, mesh)
+    mesh, state, step_fn = _build_train_state(jax, cfg)
 
     bsz = batch_size * len(devs)
     rng = np.random.default_rng(0)
@@ -68,5 +60,9 @@ def main(steps: int = 4, batch_size: int = 16,
 
 
 if __name__ == "__main__":
-    a = sys.argv[1:]
-    main(*(int(x) if i < 2 else x for i, x in enumerate(a)))
+    args = sys.argv[1:]
+    if len(args) > 3:
+        sys.exit(f"usage: {sys.argv[0]} [steps] [batch_size] [logdir]")
+    main(steps=int(args[0]) if len(args) > 0 else 4,
+         batch_size=int(args[1]) if len(args) > 1 else 16,
+         logdir=args[2] if len(args) > 2 else "profile_trace")
