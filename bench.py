@@ -492,13 +492,17 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
     run(1)                                             # warmup (steady state)
     t1 = min(run(1) for _ in range(2))
     tn = min(run(1 + steps) for _ in range(2))
-    dt = max(tn - t1, 1e-9) / steps
+    # same corrupted-slope protection as the loader rung (no loader waits
+    # here): RTT variance inflating the t(1) sample must degrade to the
+    # conservative total-window estimate, not an absurd throughput number
+    dt, timing_method, _ = loader_step_time(t1, tn, 0.0, 0.0, steps)
     imgs = bsz / dt / n_dev
     peak = profiling.chip_peak_tflops() * 1e12
     mfu = (flops / dt) / peak if flops and peak > 1e12 else None
     result = {"bs": batch_size, "px": resolution, "flash": flash,
               "images_per_sec_per_chip": round(imgs, 3),
               "step_ms": round(dt * 1e3, 1),
+              "timing_method": timing_method,
               "mfu": round(mfu, 4) if mfu else None,
               "flops_method": method,
               "gflops_per_step_chip": round(flops / 1e9, 1),
@@ -506,6 +510,25 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
               "loss": round(float(m["loss"]), 4)}
     mark("rung_done", **result)
     return result
+
+
+def loader_step_time(t1: float, tn: float, w1: float, wn: float,
+                     steps: int) -> tuple[float, str, float]:
+    """(per-step seconds, timing_method, loader_stall_fraction) from the
+    slope pair t(1)/t(1+steps) with loader-wait totals w1/wn.
+
+    Slope cancels the sync RTT, but t(1)-sample noise (prefetch backlog,
+    RTT variance) can corrupt it; a corrupted slope is recognized by being
+    implausibly SMALL next to the whole-window estimate (legit ratios stay
+    ≥ ~0.2 even when the RTT dwarfs the step: step/(step + RTT/(1+N))).
+    Then fall back to total wall over the long window — including one RTT,
+    so it can only OVERstate step time — and derive the stall fraction
+    from that SAME window, never the pair just judged unusable."""
+    total_dt = tn / (1 + steps)
+    slope_dt = (tn - t1) / steps
+    if tn - t1 > 1e-3 and slope_dt >= 0.1 * total_dt:
+        return slope_dt, "slope", min(max(wn - w1, 0.0) / steps / slope_dt, 1.0)
+    return total_dt, "total", min(wn / tn, 1.0)
 
 
 def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
@@ -585,19 +608,7 @@ def bench_loader_rung(jax, batch_size: int, dog: Watchdog, steps: int = 8,
     # per-step cost so badly the slope goes negative
     t1, w1 = min(run(1) for _ in range(2))
     tn, wn = min(run(1 + steps) for _ in range(2))
-    if tn - t1 > 1e-3:
-        dt = (tn - t1) / steps
-        method = "slope"
-        stall_frac = min(max(wn - w1, 0.0) / steps / dt, 1.0)
-    else:
-        # degenerate slope (loader-wait variance swamped the signal): fall
-        # back to total wall over the long window — includes one sync RTT,
-        # so it can only OVERstate step time / understate throughput. The
-        # stall fraction must come from the SAME window (wn over tn), not
-        # the slope pair the fallback just judged unusable.
-        dt = tn / (1 + steps)
-        method = "total"
-        stall_frac = min(wn / tn, 1.0)
+    dt, method, stall_frac = loader_step_time(t1, tn, w1, wn, steps)
     imgs = bsz / dt / n_dev
     result = {"bs": batch_size, "px": resolution, "source": "loader",
               "images_per_sec_per_chip": round(imgs, 3),

@@ -1,0 +1,64 @@
+"""Unit coverage for bench.py's loader-rung timing decision.
+
+The slope method (t(1+N) − t(1)) / N cancels the tunnel sync RTT but can
+go degenerate when a prefetch backlog inflates the t(1) sample; the
+fallback and its same-window stall accounting are pure arithmetic, so
+they get direct tests (a smoke run had produced 6e9 img/s from a negative
+slope before the fallback existed)."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from bench import loader_step_time
+
+pytestmark = pytest.mark.fast
+
+
+def test_healthy_slope_cancels_sync_overhead():
+    # 100ms/step + 500ms fixed sync in both windows; 10% loader wait
+    dt, method, stall = loader_step_time(0.6, 0.5 + 0.1 * 9, 0.01, 0.09, 8)
+    assert method == "slope"
+    assert dt == pytest.approx(0.1)
+    assert stall == pytest.approx((0.09 - 0.01) / 8 / 0.1)
+
+
+def test_degenerate_slope_falls_back_to_total_window():
+    # backlogged t(1) sample >= long window per-step: slope would be <= 0
+    dt, method, stall = loader_step_time(1.0, 0.9, 0.8, 0.45, 8)
+    assert method == "total"
+    assert dt == pytest.approx(0.9 / 9)
+    # stall from the SAME window: wn/tn, not the unusable slope pair
+    assert stall == pytest.approx(0.45 / 0.9)
+
+
+def test_stall_fraction_clamped_to_unit_interval():
+    _, _, stall = loader_step_time(0.1, 2.1, 0.0, 4.0, 8)
+    assert stall == 1.0
+    _, _, stall = loader_step_time(1.0, 0.5, 0.9, 0.6, 8)
+    assert stall <= 1.0
+
+
+def test_near_degenerate_slope_rejected_by_relative_guard():
+    # tn - t1 passes the absolute 1e-3 floor but the implied 1.25ms/step is
+    # absurd next to the 100ms whole-window estimate -> must fall back
+    dt, method, _ = loader_step_time(0.89, 0.90, 0.0, 0.0, 8)
+    assert method == "total"
+    assert dt == pytest.approx(0.90 / 9)
+
+
+def test_big_rtt_small_step_still_uses_slope():
+    # legit regime: 174ms sync RTT, 5ms true step -> ratio ~0.2, keep slope
+    dt, method, _ = loader_step_time(0.174 + 0.005, 0.174 + 0.045, 0.0, 0.0, 8)
+    assert method == "slope"
+    assert dt == pytest.approx(0.005)
+
+
+def test_loader_wait_noise_never_goes_negative():
+    # w1 > wn (first window caught the refill): slope stall clamps at 0
+    _, method, stall = loader_step_time(0.6, 1.4, 0.5, 0.1, 8)
+    assert method == "slope"
+    assert stall == 0.0
