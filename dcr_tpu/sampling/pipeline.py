@@ -41,9 +41,13 @@ from dcr_tpu.sampling.sampler import make_sampler
 log = logging.getLogger("dcr_tpu")
 
 
-def load_checkpoint_models(ckpt_dir: str | Path):
+def load_checkpoint_models(ckpt_dir: str | Path, mesh=None):
     """(models, params) from an HF-layout dir written by Trainer.export_checkpoint.
-    Model shapes come from model_index.json (our serialized ModelConfig)."""
+    Model shapes come from model_index.json (our serialized ModelConfig).
+
+    Passing a mesh with a seq axis >1 enables ring/Ulysses sequence-parallel
+    attention inside the sampler's UNet (same mechanism as training) — the
+    long-context inference path for 512px+ latents."""
     ckpt_dir = Path(ckpt_dir)
     index = json.loads((ckpt_dir / "model_index.json").read_text())
     if "model_config" in index:
@@ -66,7 +70,7 @@ def load_checkpoint_models(ckpt_dir: str | Path):
         "text": import_hf_layout(ckpt_dir, "text_encoder"),
     }
     models = DiffusionModels(
-        unet=UNet2DCondition(model_cfg),
+        unet=UNet2DCondition(model_cfg, mesh=mesh),
         vae=AutoencoderKL(model_cfg),
         text_encoder=CLIPTextModel(model_cfg),
         # model_cfg carries the schedule fields for every checkpoint flavor:
@@ -149,7 +153,7 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
     mesh = pmesh.make_mesh(cfg.mesh)
     if models is None:
         ckpt = resolve_checkpoint(cfg)
-        models, params, _ = load_checkpoint_models(ckpt)
+        models, params, _ = load_checkpoint_models(ckpt, mesh=mesh)
     tokenizer = tokenizer or load_tokenizer(
         cfg.model_path or None,
         vocab_size=models.text_encoder.config.text_vocab_size,
@@ -166,6 +170,15 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
     if dist.is_primary():
         gen_dir.mkdir(parents=True, exist_ok=True)
         save_prompts(prompts, savepath)
+
+    # a seq axis must reach the UNet module itself (ring/Ulysses attention
+    # gates on module.mesh) — callers who pass prebuilt mesh-less models
+    # would otherwise silently sample dense, defeating the requested
+    # sequence parallelism; modules are static config, so rebuilding is free
+    if mesh.shape.get(pmesh.SEQ_AXIS, 1) > 1 and models.unet.mesh is None:
+        models = models._replace(
+            unet=UNet2DCondition(models.unet.config,
+                                 dtype=models.unet.dtype, mesh=mesh))
 
     # place params on the mesh: tensor-axis meshes shard the big matmul
     # weights Megatron-style (same rules as training), fsdp axes shard by

@@ -15,21 +15,37 @@ from dcr_tpu.sampling.pipeline import generate, load_checkpoint_models, resolve_
 pytestmark = pytest.mark.slow
 
 
-@pytest.fixture(scope="module")
-def exported_ckpt(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("ckpt")
+def export_tiny_run(run_dir, model_cfg=None):
+    """Write a tiny HF-layout checkpoint under run_dir/checkpoint."""
     cfg = TrainConfig()
-    cfg.model = ModelConfig.tiny()
+    cfg.model = model_cfg or ModelConfig.tiny()
     models, params = build_models(cfg, jax.random.key(0))
-    out = tmp / "run" / "checkpoint"
     export_hf_layout(
-        out, unet=params["unet"], vae=params["vae"], text_encoder=params["text"],
+        run_dir / "checkpoint", unet=params["unet"], vae=params["vae"],
+        text_encoder=params["text"],
         scheduler_config={"num_train_timesteps": 1000,
                           "beta_schedule": "scaled_linear",
                           "beta_start": 0.00085, "beta_end": 0.012,
                           "prediction_type": "epsilon"},
         model_config=to_dict(cfg.model))
-    return tmp / "run"
+    return run_dir
+
+
+def assert_images_close(dir_a, dir_b, n, tol=1):
+    """PNG sets equal within tol uint8 LSB (reduction-order float drift)."""
+    a_files = sorted(dir_a.glob("*.png"))
+    b_files = sorted(dir_b.glob("*.png"))
+    assert len(a_files) == len(b_files) == n
+    for a, b in zip(a_files, b_files):
+        with Image.open(a) as ia, Image.open(b) as ib:
+            diff = np.abs(np.asarray(ia).astype(np.int16)
+                          - np.asarray(ib).astype(np.int16))
+            assert diff.max() <= tol, f"max pixel diff {diff.max()}"
+
+
+@pytest.fixture(scope="module")
+def exported_ckpt(tmp_path_factory):
+    return export_tiny_run(tmp_path_factory.mktemp("ckpt") / "run")
 
 
 def test_load_checkpoint_models(exported_ckpt):
@@ -78,13 +94,42 @@ def test_generate_with_tensor_parallel_mesh(exported_ckpt, tmp_path, cpu_devices
         SampleConfig(savepath=str(tmp_path / "tp"),
                      mesh=MeshConfig(data=-1, tensor=2), **common),
         modelstyle="classlevel", tokenizer=tok)
-    dp = sorted((out_dp / "generations").glob("*.png"))
-    tp = sorted((out_tp / "generations").glob("*.png"))
-    assert len(dp) == len(tp) == 4
-    for a, b in zip(dp, tp):
-        with Image.open(a) as ia, Image.open(b) as ib:
-            # TP changes compute partitioning, not the math; allow 1 uint8
-            # LSB for reduction-order float drift at rounding boundaries
-            diff = np.abs(np.asarray(ia).astype(np.int16)
-                          - np.asarray(ib).astype(np.int16))
-            assert diff.max() <= 1, f"max pixel diff {diff.max()}"
+    assert_images_close(out_dp / "generations", out_tp / "generations", 4)
+
+
+def test_generate_with_sequence_parallel_mesh(tmp_path, cpu_devices,
+                                              monkeypatch):
+    """Long-context inference: a seq-axis mesh turns on ring-attention
+    sequence parallelism inside the sampler's UNet (the same mechanism the
+    train step uses), and outputs match the pure-DP run. The ring kernel is
+    counted so the parity check can't pass vacuously if the gate (module
+    mesh, S >= seq_parallel_min_seq, divisibility) silently stops firing."""
+    import dataclasses
+
+    import dcr_tpu.ops.ring_attention as ring_mod
+    from dcr_tpu.core.config import MeshConfig
+
+    # checkpoint whose config forces the seq-parallel path at 32px
+    # (16x16 latent tokens >= threshold 64 at the UNet's top level)
+    run = export_tiny_run(
+        tmp_path / "ckpt_sp" / "run",
+        dataclasses.replace(ModelConfig.tiny(), seq_parallel_min_seq=64))
+
+    ring_calls = []
+    orig_ring = ring_mod.ring_self_attention
+    monkeypatch.setattr(
+        ring_mod, "ring_self_attention",
+        lambda *a, **k: (ring_calls.append(1), orig_ring(*a, **k))[1])
+
+    tok = HashTokenizer(1000, 16)
+    common = dict(model_path=str(run), num_batches=2, im_batch=1,
+                  resolution=32, num_inference_steps=2, sampler="ddim", seed=0)
+    out_dp = generate(SampleConfig(savepath=str(tmp_path / "dp"), **common),
+                      modelstyle="nolevel", tokenizer=tok)
+    assert not ring_calls        # dense path without a seq axis
+    out_sp = generate(
+        SampleConfig(savepath=str(tmp_path / "sp"),
+                     mesh=MeshConfig(data=-1, seq=2), **common),
+        modelstyle="nolevel", tokenizer=tok)
+    assert ring_calls            # the ring kernel actually traced
+    assert_images_close(out_dp / "generations", out_sp / "generations", 2)
