@@ -171,15 +171,6 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
         gen_dir.mkdir(parents=True, exist_ok=True)
         save_prompts(prompts, savepath)
 
-    # a seq axis must reach the UNet module itself (ring/Ulysses attention
-    # gates on module.mesh) — callers who pass prebuilt mesh-less models
-    # would otherwise silently sample dense, defeating the requested
-    # sequence parallelism; modules are static config, so rebuilding is free
-    if mesh.shape.get(pmesh.SEQ_AXIS, 1) > 1 and models.unet.mesh is None:
-        models = models._replace(
-            unet=UNet2DCondition(models.unet.config,
-                                 dtype=models.unet.dtype, mesh=mesh))
-
     # place params on the mesh: tensor-axis meshes shard the big matmul
     # weights Megatron-style (same rules as training), fsdp axes shard by
     # largest-divisible-dim, anything else replicates — so a model too big

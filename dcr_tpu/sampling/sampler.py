@@ -68,7 +68,22 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     """Build the jitted sampler: (params, input_ids, uncond_ids, key) -> images.
 
     images: [B, H, W, 3] float32 in [0, 1]. params = {"unet", "vae", "text"}.
+
+    The UNet's module mesh is reconciled with the sampling mesh here, for
+    every caller: ring/Ulysses sequence-parallel attention gates on
+    ``module.mesh``, so an absent one would silently sample dense under a
+    seq-axis mesh, and a stale one (e.g. a training mesh captured at
+    build_models time) would shard_map over the wrong device set. Modules
+    are static config — rebuilding is free.
     """
+    wants_seq = mesh.shape.get(pmesh.SEQ_AXIS, 1) > 1
+    target_mesh = mesh if wants_seq else None
+    if models.unet.mesh is not target_mesh:
+        from dcr_tpu.models.unet2d import UNet2DCondition
+
+        models = models._replace(
+            unet=UNet2DCondition(models.unet.config, dtype=models.unet.dtype,
+                                 mesh=target_mesh))
     sched = models.schedule
     latent_size = cfg.resolution // vae_scale_factor(models.vae.config)
     latent_ch = models.vae.config.vae_latent_channels

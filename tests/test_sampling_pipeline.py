@@ -133,3 +133,37 @@ def test_generate_with_sequence_parallel_mesh(tmp_path, cpu_devices,
         modelstyle="nolevel", tokenizer=tok)
     assert ring_calls            # the ring kernel actually traced
     assert_images_close(out_dp / "generations", out_sp / "generations", 2)
+
+
+def test_prebuilt_stale_mesh_models_get_reconciled(tmp_path, cpu_devices,
+                                                   monkeypatch):
+    """make_sampler reconciles the UNet's module mesh for EVERY caller:
+    models prebuilt against a training mesh (seq=1) and passed into
+    generate() with a seq-axis sampling mesh must still run ring attention
+    — not silently sample dense on the stale mesh."""
+    import dataclasses
+
+    import dcr_tpu.ops.ring_attention as ring_mod
+    from dcr_tpu.core.config import MeshConfig
+    from dcr_tpu.parallel import mesh as pmesh
+
+    cfg = TrainConfig()
+    cfg.model = dataclasses.replace(ModelConfig.tiny(), seq_parallel_min_seq=64)
+    train_mesh = pmesh.make_mesh(MeshConfig(data=-1))      # seq=1, stale
+    models, params = build_models(cfg, jax.random.key(0), mesh=train_mesh)
+    assert models.unet.mesh is train_mesh
+
+    ring_calls = []
+    orig_ring = ring_mod.ring_self_attention
+    monkeypatch.setattr(
+        ring_mod, "ring_self_attention",
+        lambda *a, **k: (ring_calls.append(1), orig_ring(*a, **k))[1])
+
+    out = generate(
+        SampleConfig(savepath=str(tmp_path / "out"), num_batches=1,
+                     im_batch=1, resolution=32, num_inference_steps=2,
+                     sampler="ddim", seed=0, mesh=MeshConfig(data=-1, seq=2)),
+        modelstyle="nolevel", tokenizer=HashTokenizer(1000, 16),
+        models=models, params=params)
+    assert ring_calls, "stale training mesh was not reconciled"
+    assert len(list((out / "generations").glob("*.png"))) == 1
