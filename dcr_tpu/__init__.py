@@ -26,6 +26,9 @@ _PUBLIC = {
     "SearchConfig": "dcr_tpu.core.config",
     "ModelConfig": "dcr_tpu.core.config",
     "MeshConfig": "dcr_tpu.core.config",
+    "FaultToleranceConfig": "dcr_tpu.core.config",
+    "QuarantineManifest": "dcr_tpu.core.resilience",
+    "retry_call": "dcr_tpu.core.resilience",
     "Trainer": "dcr_tpu.diffusion.trainer",
     "generate": "dcr_tpu.sampling.pipeline",
     "run_eval": "dcr_tpu.eval.runner",

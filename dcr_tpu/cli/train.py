@@ -13,14 +13,28 @@ def main(argv=None) -> None:
     from dcr_tpu.cli import setup_platform
 
     setup_platform()
+    # force=True: orbax/absl imports grab the root logger first, which would
+    # silently drop every INFO line (including the resume/recovery messages
+    # the fault-tolerance contract requires to be visible)
     logging.basicConfig(level=logging.INFO,
-                        format="%(asctime)s %(name)s %(message)s")
+                        format="%(asctime)s %(name)s %(message)s", force=True)
     cfg = parse_cli(TrainConfig, argv)
+    log = logging.getLogger("dcr_tpu")
+    # make an injected run unmistakable in the log from line one: DCR_FAULTS
+    # drives the deterministic fault harness (utils/faults.py)
+    from dcr_tpu.utils import faults
+
+    reg = faults.registry()
+    if reg:
+        log.warning("fault injection ACTIVE (DCR_FAULTS): %s", reg.pending())
     # periodic sample grids every save_steps (the reference's visual check)
     trainer = Trainer(cfg, sample_hook=make_sample_hook())
     trainer.install_preemption_handler()
     metrics = trainer.train()
-    logging.getLogger("dcr_tpu").info("training done: %s", metrics)
+    if reg and reg.pending():
+        log.warning("fault entries never fired (check coordinates): %s",
+                    reg.pending())
+    log.info("training done: %s", metrics)
 
 
 if __name__ == "__main__":

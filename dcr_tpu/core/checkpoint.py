@@ -9,64 +9,345 @@ idles on host I/O, which is what preemptible pods need (SURVEY.md §5.3).
 Layout of <output_dir>:
   config.json                  full serialized TrainConfig
   checkpoints/<step>/          orbax composite: state (params/opt/step), ema
+  checkpoints/manifests/<step>.json   content manifest (tree + checksums)
+  checkpoints/quarantined/<step>/     corrupt steps moved aside, never retried
 A separate exporter writes the HF-style directory-of-subfolders layout
 (unet/, vae/, text_encoder/, scheduler/) for interop with the reference's
 inference convention (diff_inference.py:83-88).
+
+Integrity: every save writes a per-step content manifest (flattened tree key
+-> crc32/shape/dtype of the host bytes) BEFORE the async orbax write begins,
+so a torn/corrupt checkpoint is detectable on restore even when orbax itself
+deserializes it without complaint. :meth:`restore_latest_valid` walks
+``all_steps()`` newest-first, quarantines steps that fail to restore or fail
+verification, and returns the newest valid one — preemptible-pod resume never
+dies on a torn latest checkpoint (the seed raised instead).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import shutil
+import zlib
 from pathlib import Path
 from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dcr_tpu.core import resilience as R
+
 log = logging.getLogger("dcr_tpu")
+
+MANIFEST_FORMAT = 1
+
+
+class CheckpointCorrupt(RuntimeError):
+    """An explicitly-requested checkpoint failed integrity verification."""
+
+
+def _leaf_key(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def state_manifest(state: Any) -> dict:
+    """Flattened-tree content manifest: per-leaf crc32 of the host bytes plus
+    shape/dtype. crc32 is not cryptographic — the adversary is a torn write or
+    bit rot, not tampering — and costs ~1GB/s on one core."""
+    leaves = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        arr = np.asarray(jax.device_get(leaf))
+        leaves[_leaf_key(path)] = {
+            "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+        }
+    return {"format": MANIFEST_FORMAT, "leaves": leaves}
+
+
+def verify_manifest(manifest: dict, state: Any) -> list[str]:
+    """Mismatch descriptions ([] = valid) between a restored state and the
+    manifest written at save time."""
+    expected = manifest.get("leaves", {})
+    problems: list[str] = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    seen = set()
+    for path, leaf in flat:
+        key = _leaf_key(path)
+        seen.add(key)
+        want = expected.get(key)
+        if want is None:
+            problems.append(f"{key}: leaf not in manifest")
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        if list(arr.shape) != want["shape"] or str(arr.dtype) != want["dtype"]:
+            problems.append(f"{key}: shape/dtype {arr.shape}/{arr.dtype} != "
+                            f"{want['shape']}/{want['dtype']}")
+        elif zlib.crc32(np.ascontiguousarray(arr).tobytes()) != want["crc32"]:
+            problems.append(f"{key}: checksum mismatch")
+    for key in set(expected) - seen:
+        problems.append(f"{key}: missing from restored state")
+    return problems
 
 
 class CheckpointManager:
-    """Thin orbax CheckpointManager wrapper, async by default."""
+    """Checkpoint manager with per-step integrity manifests and
+    quarantine-and-fall-back restore, over one of two storage backends:
+
+    - **orbax** (TPU/GPU, and any multi-process job): async by default so the
+      accelerator never idles on host I/O; sharded tensorstore writes.
+    - **npz** (single-process CPU): one ``<step>/state.npz`` per step,
+      committed by atomic directory rename. The orbax/tensorstore native
+      stack is memory-unsafe on the CPU backend in this environment
+      (use-after-free heap aborts — glibc 'corrupted size vs. prev_size' —
+      and checkpoints silently containing later-step bytes, both caught by
+      the content manifests); CPU runs are tests/smoke only, so a plain
+      numpy format loses nothing and removes every native thread from the
+      path. Both backends share the same manifest/quarantine semantics.
+    """
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
-                 async_save: bool = True):
+                 async_save: bool = True, verify: bool = True,
+                 quarantine: Optional[R.QuarantineManifest] = None):
         self._dir = Path(directory).absolute()
         self._dir.mkdir(parents=True, exist_ok=True)
-        options = ocp.CheckpointManagerOptions(
-            max_to_keep=max_to_keep,
-            enable_async_checkpointing=async_save,
-        )
-        self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        self._npz = (jax.default_backend() == "cpu"
+                     and jax.process_count() == 1)
+        self._max_to_keep = max_to_keep
+        if self._npz:
+            self._mgr = None
+        else:
+            options = ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                enable_async_checkpointing=async_save,
+            )
+            self._mgr = ocp.CheckpointManager(self._dir, options=options)
+        self._verify = verify
+        self._quarantine = quarantine
+        self._manifest_dir = self._dir / "manifests"
+
+    # -- npz backend (single-process CPU) ------------------------------------
+
+    def _npz_steps(self) -> list[int]:
+        return sorted(int(d.name) for d in self._dir.iterdir()
+                      if d.is_dir() and d.name.isdigit()
+                      and (d / "state.npz").exists())
+
+    def _npz_save(self, step: int, state: Any) -> bool:
+        flat, _ = jax.tree_util.tree_flatten_with_path(state)
+        arrays = {_leaf_key(path): np.asarray(jax.device_get(leaf))
+                  for path, leaf in flat}
+        tmp = self._dir / f"{step}.tmp-npz"
+        if tmp.exists():
+            shutil.rmtree(tmp)
+        tmp.mkdir(parents=True)
+        np.savez(tmp / "state.npz", **arrays)
+        tmp.replace(self._dir / str(step))  # atomic commit
+        # retention, oldest first (matches orbax max_to_keep)
+        steps = self._npz_steps()
+        for old in steps[: max(0, len(steps) - self._max_to_keep)]:
+            shutil.rmtree(self._dir / str(old), ignore_errors=True)
+        return True
+
+    def _npz_restore(self, step: int, state_like: Any) -> Any:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state_like)
+        leaves = []
+        with np.load(self._dir / str(step) / "state.npz") as z:
+            for path, like in flat:
+                key = _leaf_key(path)
+                if key not in z.files:
+                    raise CheckpointCorrupt(
+                        f"step {step}: leaf {key} missing from state.npz")
+                arr = z[key]
+                if tuple(arr.shape) != tuple(like.shape) or \
+                        str(arr.dtype) != str(np.dtype(like.dtype)):
+                    raise CheckpointCorrupt(
+                        f"step {step}: leaf {key} is {arr.shape}/{arr.dtype}, "
+                        f"expected {tuple(like.shape)}/{like.dtype}")
+                sharding = getattr(like, "sharding", None)
+                leaves.append(jax.device_put(arr, sharding)
+                              if sharding is not None else jnp.asarray(arr))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    # -- manifests -----------------------------------------------------------
+
+    def _manifest_path(self, step: int) -> Path:
+        return self._manifest_dir / f"{step}.json"
+
+    def _write_manifest(self, step: int, state: Any) -> None:
+        # written synchronously BEFORE the async orbax save: a crash mid-save
+        # leaves an orphan manifest (harmless), never an unverifiable step
+        self._manifest_dir.mkdir(parents=True, exist_ok=True)
+        manifest = {"step": step, **state_manifest(state)}
+        tmp = self._manifest_path(step).with_suffix(".tmp")
+        tmp.write_text(json.dumps(manifest, sort_keys=True))
+        tmp.replace(self._manifest_path(step))
+
+    def _load_manifest(self, step: int) -> Optional[dict]:
+        path = self._manifest_path(step)
+        if not path.exists():
+            return None  # pre-manifest checkpoint: accepted, logged
+        return json.loads(R.read_text_with_retry(path, name=f"manifest:{step}"))
+
+    def _prune_manifests(self, keep: Optional[int] = None) -> None:
+        if not self._manifest_dir.exists():
+            return
+        live = set(self.all_steps())
+        if keep is not None:
+            live.add(keep)  # the in-flight async save may not be listed yet
+        for mf in self._manifest_dir.glob("*.json"):
+            try:
+                if int(mf.stem) not in live:
+                    mf.unlink()
+            except ValueError:
+                continue
+
+    # -- save/restore --------------------------------------------------------
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
-        if step in self._mgr.all_steps():
+        if step in self.all_steps():
             return False  # idempotent: final save may coincide with a periodic one
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state), force=force)
+        if self._verify:
+            self._write_manifest(step, state)
+        if self._npz:
+            saved = self._npz_save(step, state)
+        else:
+            saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                   force=force)
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self._dir / str(step))
+            self._prune_manifests(keep=step)
+            from dcr_tpu.utils import faults
+
+            if faults.fire("ckpt_corrupt", step=step):
+                self.wait()
+                _corrupt_step_dir(self._dir / str(step))
         return saved
 
+    def _backend_restore(self, step: int, state_like: Any) -> Any:
+        if self._npz:
+            state = self._npz_restore(step, state_like)
+        else:
+            state = self._mgr.restore(
+                step, args=ocp.args.StandardRestore(state_like))
+        if jax.default_backend() == "cpu":
+            # device_put of host numpy on the CPU backend is ZERO-COPY: the
+            # jax array aliases numpy-owned memory, and the train step's
+            # donate_argnums then frees/reuses a buffer XLA does not own —
+            # observed as glibc heap aborts and restored params scrambling
+            # to NaN within a step or two. A jitted copy materializes the
+            # tree into XLA-owned buffers (outputs never alias inputs
+            # without donation), making the restored state donation-safe.
+            state = _materialize(state)
+        return state
+
     def restore(self, state_like: Any, step: Optional[int] = None) -> Any:
+        """Restore one explicit step (or the latest), verifying its manifest
+        when available. An explicitly-requested corrupt step raises
+        :class:`CheckpointCorrupt` — only :meth:`restore_latest_valid` walks
+        back silently."""
         step = self.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoints under {self._dir}")
-        return self._mgr.restore(step, args=ocp.args.StandardRestore(state_like))
+        state = self._backend_restore(step, state_like)
+        if self._verify:
+            manifest = self._load_manifest(step)
+            if manifest is not None:
+                problems = verify_manifest(manifest, state)
+                if problems:
+                    raise CheckpointCorrupt(
+                        f"checkpoint step {step} failed verification "
+                        f"({len(problems)} mismatches): {'; '.join(problems[:5])}")
+        return state
+
+    def restore_latest_valid(self, state_like: Any) -> tuple[Any, int, list[tuple[int, str]]]:
+        """(state, step, skipped): walk ``all_steps()`` newest-first to the
+        newest checkpoint that restores AND verifies; quarantine every bad
+        step on the way (moved to ``quarantined/<step>``, recorded, logged) so
+        it is never retried. Raises FileNotFoundError only when no valid
+        checkpoint exists at all."""
+        self.wait()
+        skipped: list[tuple[int, str]] = []
+        while True:
+            steps = sorted(self.all_steps(), reverse=True)
+            if not steps:
+                if skipped:
+                    raise FileNotFoundError(
+                        f"no valid checkpoint under {self._dir}: all "
+                        f"{len(skipped)} steps quarantined ({skipped})")
+                raise FileNotFoundError(f"no checkpoints under {self._dir}")
+            step = steps[0]
+            reason: str
+            try:
+                state = self._backend_restore(step, state_like)
+                manifest = self._load_manifest(step) if self._verify else None
+                if manifest is None:
+                    if self._verify:
+                        log.info("checkpoint step %d has no manifest "
+                                 "(pre-manifest save): accepted unverified", step)
+                    return state, step, skipped
+                problems = verify_manifest(manifest, state)
+                if not problems:
+                    return state, step, skipped
+                reason = f"verification failed: {'; '.join(problems[:3])}"
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # orbax raises many types on torn dirs
+                reason = f"restore raised: {e!r}"
+            self._quarantine_step(step, reason)
+            skipped.append((step, reason))
+
+    def _quarantine_step(self, step: int, reason: str) -> None:
+        src = self._dir / str(step)
+        dst = self._dir / "quarantined" / str(step)
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        if src.exists():
+            shutil.move(str(src), str(dst))
+        if self._mgr is not None:
+            self._mgr.reload()  # drop the moved step from orbax's cached list
+        R.log_event("ckpt_quarantined", step=step, reason=reason,
+                    moved_to=str(dst))
+        if self._quarantine is not None:
+            self._quarantine.record("bad_checkpoint", step=step, reason=reason,
+                                    moved_to=str(dst))
 
     def latest_step(self) -> Optional[int]:
+        if self._npz:
+            steps = self._npz_steps()
+            return steps[-1] if steps else None
         return self._mgr.latest_step()
 
     def all_steps(self) -> list[int]:
+        if self._npz:
+            return self._npz_steps()
         return list(self._mgr.all_steps())
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        if self._mgr is not None:
+            self._mgr.wait_until_finished()
+            self._mgr.close()
+
+
+@jax.jit
+def _materialize(tree: Any) -> Any:
+    """Copy every leaf into fresh XLA-owned buffers (see _backend_restore)."""
+    return jax.tree.map(jnp.copy, tree)
+
+
+def _corrupt_step_dir(step_dir: Path) -> None:
+    """Fault-injection helper: simulate a torn write by zero-filling every
+    file in the step dir (tests also call this directly)."""
+    for p in step_dir.rglob("*"):
+        if p.is_file():
+            p.write_bytes(b"\x00" * p.stat().st_size)
 
 
 # ---------------------------------------------------------------------------

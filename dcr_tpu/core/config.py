@@ -186,6 +186,39 @@ class DataConfig:
 
 
 @dataclass
+class FaultToleranceConfig:
+    """Recovery knobs (core/resilience.py, utils/faults.py). The defaults keep
+    the seed's fail-fast semantics: budgets of 0 mean the first bad sample /
+    non-finite loss is fatal exactly as before — recovery is opt-in per run.
+    """
+
+    # data path: extra decode attempts per sample before it counts as bad
+    decode_retries: int = 1
+    # fraction of an epoch's samples allowed to fail decode before aborting;
+    # 0 = first bad sample is fatal (seed behavior). Failed samples are
+    # replaced by a deterministic redraw from the same epoch plan and recorded
+    # in <output_dir>/quarantine.jsonl.
+    max_bad_sample_frac: float = 0.0
+    # non-finite loss: restore the last good checkpoint, fast-forward the
+    # loader past the offending data window, continue — at most this many
+    # times per run; 0 = fail fast (seed behavior).
+    max_rollbacks: int = 0
+    # write/verify per-step content manifests (tree + array checksums) next to
+    # each orbax save; restore walks back to the newest VALID checkpoint.
+    # COST: manifest hashing is a synchronous device->host pass over the full
+    # state at every save (it must snapshot before the async write starts) —
+    # disable on throughput-critical pods if save cadence is tight.
+    verify_checkpoints: bool = True
+    # transient file-I/O retry attempts (tokenizer/caption/weights reads)
+    io_retries: int = 3
+    retry_base_delay: float = 0.05
+    retry_max_delay: float = 2.0
+    # soft per-stage time budget for eval pipeline stages (watchdog warning
+    # only; 0 disables)
+    stage_deadline_secs: float = 0.0
+
+
+@dataclass
 class OptimConfig:
     learning_rate: float = 5e-6
     adam_beta1: float = 0.9
@@ -231,6 +264,7 @@ class TrainConfig:
     data: DataConfig = field(default_factory=DataConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
 
 
 @dataclass
@@ -290,6 +324,7 @@ class EvalConfig:
     use_wandb: bool = False                # wandb sink (jsonl/tb always on)
     seed: int = 42
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
 
 
 @dataclass
@@ -461,3 +496,10 @@ def validate_train_config(cfg: TrainConfig) -> None:
         raise ValueError("trainspecial mitigations require class_prompt=instancelevel_blip")
     if cfg.model.seq_parallel_mode not in ("ring", "ulysses"):
         raise ValueError("seq_parallel_mode must be 'ring' or 'ulysses'")
+    ft = cfg.fault
+    if ft.decode_retries < 0 or ft.max_rollbacks < 0:
+        raise ValueError("fault.decode_retries/max_rollbacks must be >= 0")
+    if not 0.0 <= ft.max_bad_sample_frac <= 1.0:
+        raise ValueError("fault.max_bad_sample_frac must be in [0, 1]")
+    if ft.io_retries < 1:
+        raise ValueError("fault.io_retries must be >= 1")

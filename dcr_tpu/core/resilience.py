@@ -1,0 +1,237 @@
+"""Reusable fault-tolerance primitives: retry, deadlines, quarantine.
+
+The reference stack treats every host-side failure as fatal — one corrupt
+JPEG, torn checkpoint, or transient NFS hiccup kills a multi-day preemptible
+run (SURVEY.md §5; pipeline-scale diffusion trainers treat recovery as table
+stakes, e.g. DiffusionPipe, arXiv:2405.01248). This module is the shared
+substrate the recovery paths build on:
+
+- :func:`retry_call` / :func:`retrying` — bounded retry with exponential
+  backoff + jitter, every attempt logged through :func:`log_event`;
+- :class:`Deadline` / :func:`watchdog` / :func:`stage` — soft time budgets
+  for pipeline stages: a stage that overruns emits a structured warning
+  (cooperative code can also poll ``Deadline.check()``), and every stage
+  boundary is an auditable begin/end log line;
+- :class:`QuarantineManifest` — the per-run append-only JSONL record of
+  everything that was skipped/recovered (bad samples, bad checkpoints),
+  with in-memory counters the trainer surfaces through MetricWriter.
+
+Nothing here is silent: every recovery action emits exactly one structured
+``[fault]`` log line, so a run's recovery history is greppable.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Callable, Iterator, Optional, Sequence
+
+import logging
+
+log = logging.getLogger("dcr_tpu")
+
+
+def log_event(event: str, **fields: Any) -> None:
+    """One structured, greppable line per fault/recovery action."""
+    log.warning("[fault] %s %s", event,
+                json.dumps(fields, sort_keys=True, default=str))
+
+
+# ---------------------------------------------------------------------------
+# Retry with exponential backoff
+# ---------------------------------------------------------------------------
+
+class RetriesExhausted(RuntimeError):
+    """Raised only when re-raising the original error would hide the retry
+    count; normally the last underlying exception propagates unchanged."""
+
+
+def retry_call(fn: Callable[[], Any], *, attempts: int = 3,
+               base_delay: float = 0.05, max_delay: float = 2.0,
+               jitter: float = 0.5,
+               retry_on: tuple[type[BaseException], ...] = (OSError,),
+               give_up_on: tuple[type[BaseException], ...] = (),
+               name: str = "op",
+               sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Call ``fn`` up to ``attempts`` times, backing off exponentially.
+
+    The delay before attempt k (1-indexed) is
+    ``min(max_delay, base_delay * 2**(k-1))`` scaled by a uniform jitter in
+    ``[1, 1+jitter]`` so a fleet of workers hitting the same flaky filesystem
+    doesn't retry in lockstep. Exceptions outside ``retry_on`` — or inside
+    ``give_up_on``, which wins when the classes overlap (e.g. retry OSError
+    but not FileNotFoundError) — propagate immediately; the final failure
+    re-raises the underlying exception.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if give_up_on and isinstance(e, give_up_on):
+                raise
+            if attempt == attempts:
+                log_event("retries_exhausted", name=name, attempts=attempts,
+                          error=repr(e))
+                raise
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay *= 1.0 + jitter * random.random()
+            log_event("retry", name=name, attempt=attempt, of=attempts,
+                      delay_secs=round(delay, 3), error=repr(e))
+            sleep(delay)
+    raise AssertionError("unreachable")
+
+
+def retrying(**retry_kw: Any) -> Callable:
+    """Decorator form of :func:`retry_call`."""
+    def deco(fn: Callable) -> Callable:
+        kw = dict(retry_kw)
+        kw.setdefault("name", fn.__name__)
+
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            return retry_call(lambda: fn(*args, **kwargs), **kw)
+        wrapped.__name__ = fn.__name__
+        wrapped.__doc__ = fn.__doc__
+        return wrapped
+    return deco
+
+
+# Structurally-wrong-path errors are never transient; everything else in
+# OSError space (EIO on NFS, ESTALE, connection resets) is worth a retry.
+NONTRANSIENT_IO = (FileNotFoundError, IsADirectoryError, NotADirectoryError)
+
+
+def read_bytes_with_retry(path: str | Path, *, attempts: int = 3,
+                          name: Optional[str] = None) -> bytes:
+    """File read hardened against transient I/O errors (flaky network
+    filesystems on preemptible pods). Missing files are NOT transient:
+    FileNotFoundError propagates immediately."""
+    p = Path(path)
+    return retry_call(p.read_bytes, attempts=attempts, retry_on=(OSError,),
+                      give_up_on=NONTRANSIENT_IO, name=name or f"read:{p.name}")
+
+
+def read_text_with_retry(path: str | Path, *, attempts: int = 3,
+                         encoding: str = "utf-8",
+                         name: Optional[str] = None) -> str:
+    return read_bytes_with_retry(path, attempts=attempts, name=name).decode(encoding)
+
+
+# ---------------------------------------------------------------------------
+# Deadlines / watchdog
+# ---------------------------------------------------------------------------
+
+class DeadlineExceeded(TimeoutError):
+    pass
+
+
+class Deadline:
+    """A soft time budget. ``check()`` raises for cooperative cancellation;
+    the :func:`watchdog` timer logs even when the stage never polls."""
+
+    def __init__(self, seconds: float, name: str = "deadline"):
+        self.seconds = float(seconds)
+        self.name = name
+        self.start = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self.start
+
+    def remaining(self) -> float:
+        return self.seconds - self.elapsed() if self.seconds > 0 else float("inf")
+
+    def expired(self) -> bool:
+        return self.seconds > 0 and self.elapsed() > self.seconds
+
+    def check(self) -> None:
+        if self.expired():
+            raise DeadlineExceeded(
+                f"{self.name}: exceeded {self.seconds:.1f}s budget "
+                f"(elapsed {self.elapsed():.1f}s)")
+
+
+@contextmanager
+def watchdog(name: str, seconds: float,
+             on_timeout: Optional[Callable[[], None]] = None) -> Iterator[Deadline]:
+    """Run a block under a soft deadline: if it is still running after
+    ``seconds``, emit one structured warning (and call ``on_timeout``).
+    ``seconds <= 0`` disables the timer. The block is never killed — host
+    threads can't be safely interrupted — but the overrun becomes auditable
+    and cooperative code can poll the yielded :class:`Deadline`."""
+    dl = Deadline(seconds, name=name)
+    timer: Optional[threading.Timer] = None
+    if seconds > 0:
+        def fire() -> None:
+            log_event("watchdog_timeout", name=name, budget_secs=seconds)
+            if on_timeout is not None:
+                on_timeout()
+        timer = threading.Timer(seconds, fire)
+        timer.daemon = True
+        timer.start()
+    try:
+        yield dl
+    finally:
+        if timer is not None:
+            timer.cancel()
+
+
+@contextmanager
+def stage(name: str, deadline: float = 0.0) -> Iterator[Deadline]:
+    """Auditable pipeline-stage boundary: logs begin/end with wall duration,
+    warns (via :func:`watchdog`) when the stage overruns its soft budget,
+    and logs a structured failure line when the stage raises."""
+    t0 = time.monotonic()
+    log.info("[stage] %s: begin", name)
+    try:
+        with watchdog(f"stage:{name}", deadline) as dl:
+            yield dl
+    except BaseException as e:
+        log_event("stage_failed", stage=name,
+                  secs=round(time.monotonic() - t0, 2), error=repr(e))
+        raise
+    log.info("[stage] %s: done in %.2fs", name, time.monotonic() - t0)
+
+
+# ---------------------------------------------------------------------------
+# Quarantine manifest
+# ---------------------------------------------------------------------------
+
+class QuarantineManifest:
+    """Per-run append-only JSONL record of recovered-from failures.
+
+    One record per quarantined item (bad sample, bad checkpoint step, ...),
+    written through a lock so loader worker threads can record concurrently.
+    ``counts`` holds in-memory per-kind counters the trainer reports through
+    MetricWriter (``faults/bad_samples`` etc.); they reset with the process,
+    while the JSONL file is the durable audit trail.
+    """
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def record(self, kind: str, **fields: Any) -> dict:
+        rec = {"kind": kind, "time": time.time(), **fields}
+        with self._lock:
+            self.counts[kind] = self.counts.get(kind, 0) + 1
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            with self.path.open("a") as f:
+                f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+        log_event(f"quarantine_{kind}", **fields)
+        return rec
+
+    def count(self, kind: str) -> int:
+        with self._lock:
+            return self.counts.get(kind, 0)
+
+    def entries(self) -> list[dict]:
+        if not self.path.exists():
+            return []
+        return [json.loads(line) for line in self.path.read_text().splitlines()
+                if line.strip()]

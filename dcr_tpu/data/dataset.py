@@ -18,13 +18,25 @@ from typing import Optional
 import numpy as np
 from PIL import Image
 
-from dcr_tpu.core.config import DataConfig
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core.config import DataConfig, FaultToleranceConfig
 from dcr_tpu.core.rng import host_python_rng
 from dcr_tpu.data import captions as C
 from dcr_tpu.data import duplication as D
 from dcr_tpu.data.tokenizer import TokenizerBase
 
 IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".bmp", ".webp", ".ppm", ".tif", ".tiff")
+
+
+class SampleDecodeError(RuntimeError):
+    """A sample failed to decode after all retry attempts. Carries enough
+    context for the loader's quarantine manifest."""
+
+    def __init__(self, index: int, path: str, cause: BaseException):
+        super().__init__(f"sample {index} ({path}) failed to decode: {cause!r}")
+        self.index = index
+        self.path = path
+        self.cause = cause
 
 
 def list_image_folder(root: str | Path) -> tuple[list[str], list[int], list[str]]:
@@ -104,9 +116,11 @@ class ObjectAttributeDataset:
     """Deterministic map-style dataset over an image folder."""
 
     def __init__(self, cfg: DataConfig, tokenizer: TokenizerBase,
-                 caption_tables: Optional[dict] = None):
+                 caption_tables: Optional[dict] = None,
+                 fault: Optional[FaultToleranceConfig] = None):
         self.cfg = cfg
         self.tokenizer = tokenizer
+        self.fault = fault or FaultToleranceConfig()
         self.paths, self.labels, self.classes = list_image_folder(cfg.train_data_dir)
         # classnames: Imagenette convention when recognizable, else folder names
         if any(s in str(cfg.train_data_dir) for s in ("imagenette", "Imagenette")):
@@ -117,7 +131,8 @@ class ObjectAttributeDataset:
         if self.prompts is None and cfg.caption_jsons:
             self.prompts = {}
             for j in cfg.caption_jsons:
-                self.prompts.update(json.loads(Path(j).read_text()))
+                self.prompts.update(json.loads(R.read_text_with_retry(
+                    j, attempts=self.fault.io_retries, name=f"captions:{j}")))
         needs_prompts = cfg.class_prompt.startswith("instancelevel") or (
             cfg.trainspecial not in (None, "none"))
         if needs_prompts and not self.prompts:
@@ -156,16 +171,35 @@ class ObjectAttributeDataset:
         different captions' depends on it). Defaults to position for direct use."""
         index = int(self.active_indices[position])
         slot = position if slot is None else slot
-        rng = host_python_rng(self.cfg.seed, f"sample_e{epoch}_s{slot}_i{index}")
-        pixels = load_and_transform(
-            self.paths[index], self.cfg.resolution,
-            center_crop=self.cfg.center_crop,
-            random_flip=self.cfg.random_flip, rng=rng)
-        caption = C.assign_caption(
-            self.spec, path=self.paths[index], label=self.labels[index],
-            classnames=self.classnames, prompts=self.prompts,
-            sampling_weight=float(self.sampling_weights[index]),
-            tokenizer=self.tokenizer, rng=rng)
-        ids = self.tokenizer(caption)[0]
-        return Example(pixel_values=pixels, input_ids=ids, index=index,
-                       caption=caption)
+
+        def build() -> Example:
+            # a fresh rng per attempt: a retried decode must produce the
+            # byte-identical example a first-try success would have
+            rng = host_python_rng(self.cfg.seed, f"sample_e{epoch}_s{slot}_i{index}")
+            pixels = load_and_transform(
+                self.paths[index], self.cfg.resolution,
+                center_crop=self.cfg.center_crop,
+                random_flip=self.cfg.random_flip, rng=rng)
+            caption = C.assign_caption(
+                self.spec, path=self.paths[index], label=self.labels[index],
+                classnames=self.classnames, prompts=self.prompts,
+                sampling_weight=float(self.sampling_weights[index]),
+                tokenizer=self.tokenizer, rng=rng)
+            ids = self.tokenizer(caption)[0]
+            return Example(pixel_values=pixels, input_ids=ids, index=index,
+                           caption=caption)
+
+        ft = self.fault
+        try:
+            # retry transient AND deterministic decode errors alike: one spare
+            # attempt is cheap, and a truly-corrupt file fails identically and
+            # escalates to SampleDecodeError for the loader's quarantine
+            return R.retry_call(build, attempts=1 + max(0, ft.decode_retries),
+                                base_delay=ft.retry_base_delay,
+                                max_delay=ft.retry_max_delay,
+                                retry_on=(Exception,),
+                                name=f"decode:{Path(self.paths[index]).name}")
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:
+            raise SampleDecodeError(index, self.paths[index], e) from e

@@ -20,6 +20,8 @@ from typing import Iterator, Optional
 
 import numpy as np
 
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core.config import FaultToleranceConfig
 from dcr_tpu.data import duplication as D
 from dcr_tpu.data.dataset import ObjectAttributeDataset
 
@@ -29,6 +31,10 @@ class Batch(dict):
     index [B]."""
 
     __getattr__ = dict.__getitem__
+
+
+class TooManyBadSamples(RuntimeError):
+    """The epoch's quarantine budget (fault.max_bad_sample_frac) is spent."""
 
 
 def sampling_plan(dataset: ObjectAttributeDataset, *, epoch: int,
@@ -47,7 +53,9 @@ class DataLoader:
     def __init__(self, dataset: ObjectAttributeDataset, *, batch_size: int,
                  num_workers: int = 8, seed: int = 0,
                  process_index: int = 0, process_count: int = 1,
-                 drop_last: bool = True, prefetch: int = 4):
+                 drop_last: bool = True, prefetch: int = 4,
+                 fault: Optional[FaultToleranceConfig] = None,
+                 quarantine: Optional[R.QuarantineManifest] = None):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.dataset = dataset
@@ -59,6 +67,12 @@ class DataLoader:
         self.process_count = process_count
         self.drop_last = drop_last
         self.prefetch = prefetch
+        # fault=None (or max_bad_sample_frac=0) keeps the seed's fail-fast
+        # contract: the first bad sample kills the epoch
+        self.fault = fault
+        self.quarantine = quarantine
+        self.bad_samples = 0  # run-total, surfaced as faults/bad_samples
+        self._bad_lock = threading.Lock()
         if len(dataset) < self.global_batch_size and drop_last:
             raise ValueError(
                 f"dataset of {len(dataset)} samples can't fill one global batch "
@@ -68,18 +82,57 @@ class DataLoader:
         return len(self.dataset) // self.global_batch_size
 
     def epoch(self, epoch: int, start_step: int = 0) -> Iterator[Batch]:
-        """Yield this process's local batches for one epoch."""
+        """Yield this process's local batches for one epoch.
+
+        Bad samples (decode failures after the dataset's own retries, or
+        injected ``decode_error`` faults) are quarantined when
+        ``fault.max_bad_sample_frac > 0``: the occurrence is replaced by a
+        deterministic redraw from the same epoch plan (the next plan slot that
+        decodes — the example another step would legitimately produce there,
+        so the substitution is reproducible across restarts and processes),
+        recorded in the quarantine manifest, and counted against the epoch's
+        budget. Past the budget — or with the default budget of 0 — the error
+        propagates to the consumer exactly as in the seed.
+        """
         plan = sampling_plan(self.dataset, epoch=epoch, seed=self.seed)
         steps = self.steps_per_epoch()
         out_q: "queue.Queue[tuple[int, Optional[Batch], Optional[BaseException]]]" = (
             queue.Queue(maxsize=self.prefetch))
         stop = threading.Event()
+        epoch_samples = steps * self.global_batch_size
+        budget_frac = self.fault.max_bad_sample_frac if self.fault else 0.0
+        epoch_budget = int(budget_frac * epoch_samples)
+        epoch_bad = [0]  # shared across workers, guarded by _bad_lock
+
+        def fetch(step: int, slot: int):
+            from dcr_tpu.utils import faults
+
+            position = int(plan[slot])
+            # the `index` coordinate is the DATASET index — the same value the
+            # quarantine manifest records for this occurrence
+            if faults.fire("decode_error", step=step, slot=slot,
+                           index=int(self.dataset.active_indices[position]),
+                           epoch=epoch):
+                raise faults.InjectedFault(
+                    f"decode_error at epoch={epoch} step={step} slot={slot}")
+            return self.dataset.get(position, epoch=epoch, slot=slot)
+
+        def fetch_or_replace(step: int, slot: int):
+            try:
+                return fetch(step, slot)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as err:
+                return self._replace(err, plan=plan, epoch=epoch, step=step,
+                                     slot=slot, fetch=fetch,
+                                     epoch_bad=epoch_bad,
+                                     epoch_budget=epoch_budget,
+                                     budget_frac=budget_frac)
 
         def make_batch(step: int) -> Batch:
             base = step * self.global_batch_size + self.process_index * self.batch_size
-            positions = plan[base: base + self.batch_size]
-            examples = [self.dataset.get(int(p), epoch=epoch, slot=base + j)
-                        for j, p in enumerate(positions)]
+            examples = [fetch_or_replace(step, base + j)
+                        for j in range(self.batch_size)]
             return Batch(
                 pixel_values=np.stack([e.pixel_values for e in examples]),
                 input_ids=np.stack([e.input_ids for e in examples]),
@@ -131,3 +184,52 @@ class DataLoader:
                         out_q.get_nowait()
                     except queue.Empty:
                         t.join(timeout=0.05)
+
+    def _replace(self, err: BaseException, *, plan: np.ndarray, epoch: int,
+                 step: int, slot: int, fetch, epoch_bad: list,
+                 epoch_budget: int, budget_frac: float):
+        """Quarantine a bad occurrence and return its deterministic
+        replacement, or re-raise when recovery is disabled / budget is spent.
+        Thread-safe: loader workers hit this concurrently."""
+        ds = self.dataset
+        bad_position = int(plan[slot])
+        bad_index = int(ds.active_indices[bad_position])
+        if budget_frac <= 0:
+            raise err  # seed behavior: no quarantine budget configured
+        with self._bad_lock:
+            epoch_bad[0] += 1
+            self.bad_samples += 1
+            n_bad = epoch_bad[0]
+        if n_bad > epoch_budget:
+            raise TooManyBadSamples(
+                f"epoch {epoch}: {n_bad} bad samples exceed the quarantine "
+                f"budget of {epoch_budget} (max_bad_sample_frac={budget_frac} "
+                f"of {len(plan)} samples); last failure: {err!r}") from err
+        # deterministic redraw from the SAME epoch plan: walk forward to the
+        # next slot whose sample decodes — (epoch, slot) fully determine the
+        # example, so every process/restart substitutes identically
+        last: BaseException = err
+        for k in range(1, len(plan)):
+            cand = (slot + k) % len(plan)
+            try:
+                example = fetch(step, cand)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as cand_err:
+                last = cand_err
+                continue
+            if self.quarantine is not None:
+                self.quarantine.record(
+                    "bad_sample", epoch=epoch, step=step, slot=slot,
+                    index=bad_index, path=ds.paths[bad_index],
+                    replacement_slot=cand,
+                    replacement_index=int(ds.active_indices[int(plan[cand])]),
+                    error=repr(err))
+            else:
+                R.log_event("bad_sample_replaced", epoch=epoch, step=step,
+                            slot=slot, index=bad_index, replacement_slot=cand,
+                            error=repr(err))
+            return example
+        raise TooManyBadSamples(
+            f"epoch {epoch}: no decodable replacement found in the entire "
+            f"plan ({len(plan)} slots); last failure: {last!r}") from err

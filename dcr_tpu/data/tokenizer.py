@@ -26,6 +26,8 @@ from typing import Sequence
 
 import numpy as np
 
+from dcr_tpu.core import resilience as R
+
 
 class TokenizerBase:
     vocab_size: int
@@ -97,9 +99,15 @@ class ClipBPETokenizer(TokenizerBase):
         # kept so trainers can republish the files into their output dir
         # (the diffusers `tokenizer/` subfolder contract)
         self.vocab_path, self.merges_path = vocab_path, merges_path
-        self.encoder: dict[str, int] = json.loads(vocab_path.read_text())
-        merges_text = (gzip.open(merges_path, "rt", encoding="utf-8").read()
-                       if merges_path.suffix == ".gz" else merges_path.read_text())
+        # vocab/merges live on network filesystems in pod runs; transient
+        # read errors are retried (core/resilience.py), missing files are not
+        self.encoder: dict[str, int] = json.loads(
+            R.read_text_with_retry(vocab_path, name=f"vocab:{vocab_path.name}"))
+        merges_raw = R.read_bytes_with_retry(merges_path,
+                                             name=f"merges:{merges_path.name}")
+        merges_text = (gzip.decompress(merges_raw).decode("utf-8")
+                       if merges_path.suffix == ".gz"
+                       else merges_raw.decode("utf-8"))
         lines = merges_text.split("\n")
         if lines and lines[0].startswith("#"):
             lines = lines[1:]

@@ -19,10 +19,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from dcr_tpu.core import dist
+from dcr_tpu.core import resilience as R
 from dcr_tpu.core.checkpoint import CheckpointManager, export_hf_layout
 from dcr_tpu.core.config import TrainConfig, run_name, save_config, to_dict, validate_train_config
 from dcr_tpu.core.metrics import MetricWriter
 from dcr_tpu.core import rng as rngmod
+from dcr_tpu.utils import faults
 from dcr_tpu.data.dataset import ObjectAttributeDataset
 from dcr_tpu.data.loader import DataLoader
 from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
@@ -34,6 +36,15 @@ from dcr_tpu.models.vae import init_vae, vae_scale_factor
 from dcr_tpu.parallel import mesh as pmesh
 
 log = logging.getLogger("dcr_tpu")
+
+
+@jax.jit
+def _params_finite(tree) -> jax.Array:
+    """True iff every floating leaf is finite (on-device reduction; used to
+    reject poisoned checkpoints during NaN rollback)."""
+    leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
 
 
 def build_modules(cfg: TrainConfig, mesh=None) -> "T.DiffusionModels":
@@ -119,14 +130,22 @@ class Trainer:
                 f"model.text_vocab_size ({cfg.model.text_vocab_size})")
         if dist.is_primary():
             self._publish_tokenizer()
-        self.dataset = dataset or ObjectAttributeDataset(cfg.data, self.tokenizer)
+        # per-run quarantine manifest: the durable record of every recovered
+        # failure (bad samples, bad checkpoints, rollbacks); one file per
+        # process so loader workers on every host can record locally
+        pidx = dist.process_index()
+        qname = "quarantine.jsonl" if pidx == 0 else f"quarantine.p{pidx}.jsonl"
+        self.quarantine = R.QuarantineManifest(self.out_dir / qname)
+        self.dataset = dataset or ObjectAttributeDataset(
+            cfg.data, self.tokenizer, fault=cfg.fault)
         # train_batch_size is per-device (reference semantics: per-GPU batch ×
         # num_processes, diff_train.py:556); each process loads for its local chips
         local_bs = cfg.train_batch_size * jax.local_device_count()
         self.loader = DataLoader(
             self.dataset, batch_size=local_bs,
             num_workers=cfg.data.num_workers, seed=cfg.data.seed,
-            process_index=dist.process_index(), process_count=dist.process_count())
+            process_index=dist.process_index(), process_count=dist.process_count(),
+            fault=cfg.fault, quarantine=self.quarantine)
         root = rngmod.root_key(cfg.seed)
         self.models, params = build_models(cfg, rngmod.stream_key(root, "init"),
                                            mesh=self.mesh)
@@ -144,8 +163,15 @@ class Trainer:
                                    wandb_project="diffrep_ft",
                                    run_name=run_name(cfg))
         self.ckpt = CheckpointManager(self.out_dir / "checkpoints",
-                                      max_to_keep=cfg.checkpoints_total_limit)
+                                      max_to_keep=cfg.checkpoints_total_limit,
+                                      verify=cfg.fault.verify_checkpoints,
+                                      quarantine=self.quarantine)
         self.sample_hook = sample_hook
+        # recovery counters, surfaced through MetricWriter at every log
+        # boundary (faults/bad_samples rides self.loader.bad_samples)
+        self._rollbacks = 0
+        self._ckpt_fallbacks = 0
+        self._nan_pending = False
 
     def _publish_tokenizer(self) -> None:
         """Copy BPE vocab/merges into <output_dir>/tokenizer so every
@@ -179,12 +205,68 @@ class Trainer:
         self.ckpt.save(int(jax.device_get(self.state.step)), self.state, force=force)
 
     def maybe_resume(self) -> int:
-        latest = self.ckpt.latest_step()
-        if latest is None:
+        if self.ckpt.latest_step() is None:
             return 0
-        self.state = self.ckpt.restore(self.state, latest)
-        log.info("resumed from checkpoint step %d", latest)
-        return latest
+        # walk back to the newest VALID checkpoint: a torn/corrupt latest
+        # step is quarantined (logged + recorded) and the previous one is
+        # restored instead of crashing the resume. Raises only when EVERY
+        # checkpoint is invalid — restarting from scratch silently would
+        # mask the loss of the whole run.
+        state, step, skipped = self.ckpt.restore_latest_valid(self.state)
+        self.state = state
+        self._ckpt_fallbacks += len(skipped)
+        if skipped:
+            log.warning("resume fell back past %d corrupt checkpoint(s): %s",
+                        len(skipped), [s for s, _ in skipped])
+        log.info("resumed from checkpoint step %d", step)
+        return step
+
+    def _rollback_after_nan(self, step: int, loss: float) -> bool:
+        """NaN rollback-and-skip (opt-in via fault.max_rollbacks): restore the
+        last good checkpoint, keep the data pointer at ``step`` so the window
+        that produced the non-finite loss is fast-forwarded past, and continue.
+        Returns False when rollback is disabled, exhausted, or impossible
+        (no checkpoint yet) — the caller then fails fast exactly as the seed.
+        """
+        ft = self.cfg.fault
+        if self._rollbacks >= ft.max_rollbacks:
+            return False
+        self.ckpt.wait()  # flush pending async writes before reading steps
+        if self.ckpt.latest_step() is None:
+            R.log_event("nan_rollback_impossible", at_step=step,
+                        reason="no checkpoint to roll back to")
+            return False
+        skipped_total = 0
+        while True:
+            try:
+                state, ckpt_step, skipped = self.ckpt.restore_latest_valid(self.state)
+            except FileNotFoundError as e:
+                R.log_event("nan_rollback_impossible", at_step=step, reason=repr(e))
+                self._ckpt_fallbacks += skipped_total
+                return False
+            skipped_total += len(skipped)
+            # a checkpoint written between the unchecked window's boundaries
+            # can itself carry non-finite params (checksums only prove the
+            # bytes round-tripped, not that they were ever sane) — rolling
+            # back to it would just re-trip the guard, so quarantine it and
+            # keep walking
+            if _params_finite(T.trainable_of(state, self.cfg.train_text_encoder)):
+                break
+            self.ckpt._quarantine_step(
+                ckpt_step, f"non-finite params (rollback from step {step})")
+        self._ckpt_fallbacks += skipped_total
+        self._rollbacks += 1
+        # params/opt/EMA come from ckpt_step; the step counter is fast-
+        # forwarded to the failure point so the loader (and the per-step rng
+        # streams, which key off state.step) continue past the bad window
+        new_step = jax.device_put(
+            jnp.asarray(step, jnp.asarray(state.step).dtype), state.step.sharding)
+        self.state = state.replace(step=new_step)
+        self.quarantine.record(
+            "nan_rollback", at_step=step, restored_step=ckpt_step, loss=loss,
+            rollback=self._rollbacks, max_rollbacks=ft.max_rollbacks,
+            skipped_steps=step - ckpt_step)
+        return True
 
     def export_checkpoint(self, tag: str = "checkpoint") -> Path:
         """HF-style directory-of-subfolders export (reference save format,
@@ -304,13 +386,31 @@ class Trainer:
                 self.state, metrics = self.step_fn(self.state, sharded, self.train_key)
                 step += 1
                 imgs_last += global_bs
+                # deterministic fault-injection hooks (zero-cost when
+                # DCR_FAULTS is unset): nan_loss poisons the next observed
+                # loss; sigterm drives the real preemption path
+                if faults.fire("nan_loss", step=step):
+                    self._nan_pending = True
+                if faults.fire("sigterm", step=step):
+                    import os
+                    import signal as _signal
+
+                    os.kill(os.getpid(), _signal.SIGTERM)
                 at_sync = step % accum == 0
                 sync = step // accum
                 if flops_per_step is None:
                     flops_per_step = self._step_flops(sharded)
                 if (at_sync and sync % cfg.log_every == 0) or step == max_micro:
                     metrics = jax.device_get(metrics)
+                    if self._nan_pending:
+                        metrics["loss"] = float("nan")
+                        self._nan_pending = False
                     if not np.isfinite(metrics["loss"]):
+                        if self._rollback_after_nan(step, float(metrics["loss"])):
+                            # params restored, data pointer kept at `step` —
+                            # the offending window is skipped; continue
+                            t_last, imgs_last = time.time(), 0
+                            continue
                         # fail fast instead of training on garbage (the
                         # reference has no such guard, SURVEY §5.2). Do NOT
                         # save: params already absorbed the non-finite update —
@@ -333,6 +433,11 @@ class Trainer:
                         metrics["tflops_per_sec"] = (
                             per_chip * jax.device_count() / 1e12)
                         metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
+                    # recovery counters: no retry/rollback is ever silent —
+                    # each also logged a structured [fault] line when it fired
+                    metrics["faults/bad_samples"] = self.loader.bad_samples
+                    metrics["faults/rollbacks"] = self._rollbacks
+                    metrics["faults/ckpt_fallbacks"] = self._ckpt_fallbacks
                     self.writer.scalars(sync, metrics)
                     last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                     t_last, imgs_last = time.time(), 0

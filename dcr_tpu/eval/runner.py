@@ -27,6 +27,7 @@ import jax
 import numpy as np
 
 from dcr_tpu.core import dist
+from dcr_tpu.core import resilience as R
 from dcr_tpu.core.config import EvalConfig
 from dcr_tpu.core.metrics import MetricWriter
 from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
@@ -228,11 +229,19 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                              normalize=HALF_NORM, caption_json=values_caption_json)
     log.info("eval: %d query (gen) vs %d values (train)", len(query), len(values))
 
+    # every stage below is an auditable [stage] boundary with a soft watchdog
+    # budget (fault.stage_deadline_secs; 0 = just the begin/end log lines)
+    stage_deadline = cfg.fault.stage_deadline_secs
+
     if backbone_params is None and cfg.weights_path:
         log.info("loading %s backbone weights from %s", cfg.pt_style,
                  cfg.weights_path)
-        backbone_params = load_backbone_params(cfg.pt_style, cfg.arch,
-                                               cfg.weights_path)
+        # weights live on network filesystems in pod runs: retry transient I/O
+        backbone_params = R.retry_call(
+            lambda: load_backbone_params(cfg.pt_style, cfg.arch,
+                                         cfg.weights_path),
+            attempts=cfg.fault.io_retries, retry_on=(OSError,),
+            give_up_on=R.NONTRANSIENT_IO, name="load_backbone_weights")
     # reference splitloss + dino layer>1: token-level features, similarity
     # chunked per token (numpatches -> num_loss_chunks aliasing,
     # diff_retrieval.py:394-395, utils_ret.py:729-737)
@@ -257,19 +266,21 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                 f"{cfg.num_loss_chunks} or set it to {apply_fn.n_tokens}")
         num_loss_chunks = apply_fn.n_tokens
     extractor = make_extractor(apply_fn, params, mesh, multiscale=cfg.multiscale)
-    query_feats = SIM.l2_normalize(extract_features(query, extractor,
-                                                    batch_size=cfg.batch_size))
-    values_feats = SIM.l2_normalize(extract_features(values, extractor,
-                                                     batch_size=cfg.batch_size))
+    with R.stage("eval/features", deadline=stage_deadline):
+        query_feats = SIM.l2_normalize(extract_features(query, extractor,
+                                                        batch_size=cfg.batch_size))
+        values_feats = SIM.l2_normalize(extract_features(values, extractor,
+                                                         batch_size=cfg.batch_size))
 
-    sim = SIM.similarity_matrix(values_feats, query_feats,
-                                metric=cfg.similarity_metric,
-                                num_chunks=num_loss_chunks,
-                                chunk_style=cfg.chunk_style, mesh=mesh)
-    stats = SIM.gen_train_stats(sim)
-    scalars: dict = stats.scalars()
-    bg = SIM.train_train_background(values_feats, mesh=mesh)
-    scalars.update(SIM.background_stats(bg))
+    with R.stage("eval/similarity", deadline=stage_deadline):
+        sim = SIM.similarity_matrix(values_feats, query_feats,
+                                    metric=cfg.similarity_metric,
+                                    num_chunks=num_loss_chunks,
+                                    chunk_style=cfg.chunk_style, mesh=mesh)
+        stats = SIM.gen_train_stats(sim)
+        scalars: dict = stats.scalars()
+        bg = SIM.train_train_background(values_feats, mesh=mesh)
+        scalars.update(SIM.background_stats(bg))
     if dist.is_primary():
         out_dir.mkdir(parents=True, exist_ok=True)
         from dcr_tpu.utils.provenance import stamp
@@ -279,43 +290,48 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
         G.histogram_plot(stats.top1, bg, out_dir / "histogram.png")
 
     if cfg.compute_clip_score:
-        scorer_params = None
-        if cfg.clip_weights_path:
-            from dcr_tpu.models.convert import convert_openai_clip, load_torch_file
+        with R.stage("eval/clip_score", deadline=stage_deadline):
+            scorer_params = None
+            if cfg.clip_weights_path:
+                from dcr_tpu.models.convert import convert_openai_clip, load_torch_file
 
-            scorer_params = convert_openai_clip(
-                load_torch_file(cfg.clip_weights_path))
-            scorer = make_clip_scorer()
-            _validate_params(
-                jax.eval_shape(lambda k: init_clip_scorer(k, scorer),
-                               jax.random.key(0)),
-                scorer_params, "CLIP scorer")
-        scalars["gen_clipscore"] = clip_alignment_score(
-            query, tokenizer, mesh, scorer_params=scorer_params)
-        scalars["train_clipscore"] = clip_alignment_score(
-            values, tokenizer, mesh, scorer_params=scorer_params)
+                scorer_params = convert_openai_clip(R.retry_call(
+                    lambda: load_torch_file(cfg.clip_weights_path),
+                    attempts=cfg.fault.io_retries, retry_on=(OSError,),
+                    give_up_on=R.NONTRANSIENT_IO, name="load_clip_weights"))
+                scorer = make_clip_scorer()
+                _validate_params(
+                    jax.eval_shape(lambda k: init_clip_scorer(k, scorer),
+                                   jax.random.key(0)),
+                    scorer_params, "CLIP scorer")
+            scalars["gen_clipscore"] = clip_alignment_score(
+                query, tokenizer, mesh, scorer_params=scorer_params)
+            scalars["train_clipscore"] = clip_alignment_score(
+                values, tokenizer, mesh, scorer_params=scorer_params)
 
     if cfg.compute_complexity:
         # de-duplicated streaming measurement: unique match images are decoded
         # once and reduced to scalars immediately — bounded host memory at
         # LAION scale (the reference holds every match image in a list,
         # diff_retrieval.py:497-559)
-        series = CX.streamed_series(values.load, stats.top1_index)
-        scalars.update(CX.correlations_from_series(series, stats.top1))
-        if dist.is_primary():
-            G.scatter_plot(np.asarray(series["entropy"]), stats.top1,
-                           "match entropy", "top1 sim",
-                           out_dir / "scatter_entropy.png")
-            G.scatter_plot(np.asarray(series["jpeg_bytes"]), stats.top1,
-                           "match jpeg bytes", "top1 sim",
-                           out_dir / "scatter_jpegsize.png")
-            G.scatter_plot(np.asarray(series["tv"]), stats.top1,
-                           "match total variation", "top1 sim",
-                           out_dir / "scatter_tv.png")
+        with R.stage("eval/complexity", deadline=stage_deadline):
+            series = CX.streamed_series(values.load, stats.top1_index)
+            scalars.update(CX.correlations_from_series(series, stats.top1))
+            if dist.is_primary():
+                G.scatter_plot(np.asarray(series["entropy"]), stats.top1,
+                               "match entropy", "top1 sim",
+                               out_dir / "scatter_entropy.png")
+                G.scatter_plot(np.asarray(series["jpeg_bytes"]), stats.top1,
+                               "match jpeg bytes", "top1 sim",
+                               out_dir / "scatter_jpegsize.png")
+                G.scatter_plot(np.asarray(series["tv"]), stats.top1,
+                               "match total variation", "top1 sim",
+                               out_dir / "scatter_tv.png")
 
     if cfg.dup_weights_pickle:
-        with open(cfg.dup_weights_pickle, "rb") as f:
-            weights = np.asarray(pickle.load(f))
+        weights = np.asarray(pickle.loads(R.read_bytes_with_retry(
+            cfg.dup_weights_pickle, attempts=cfg.fault.io_retries,
+            name="dup_weights_pickle")))
         dup = SIM.dup_vs_nondup_means(stats.top1, stats.top1_index, weights)
         scalars.update(dup)
         if dist.is_primary():
@@ -323,49 +339,53 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                           out_dir / "dup_barplot.png")
 
     if cfg.compute_fid:
-        inception = InceptionV3FID()
-        if inception_params is None and cfg.inception_weights_path:
-            from dcr_tpu.models.convert import convert_inception_fid, load_torch_file
+        with R.stage("eval/fid_ipr", deadline=stage_deadline):
+            inception = InceptionV3FID()
+            if inception_params is None and cfg.inception_weights_path:
+                from dcr_tpu.models.convert import convert_inception_fid, load_torch_file
 
-            inception_params = convert_inception_fid(
-                load_torch_file(cfg.inception_weights_path))
-            _validate_params(
-                jax.eval_shape(
-                    inception.init, jax.random.key(0),
-                    jax.ShapeDtypeStruct((1, 299, 299, 3), jnp.float32))["params"],
-                inception_params, "FID Inception")
-        if inception_params is None:
-            inception_params = inception.init(
-                jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]
-        fid_extract = make_extractor(
-            lambda p, x: inception.apply({"params": p}, x), inception_params, mesh)
-        # reference FID feeds whole (uncropped) images; inception scales inputs
-        q_raw = EvalImageFolder(cfg.query_dir, 299, crop=False)
-        v_raw = EvalImageFolder(cfg.values_dir, 299, crop=False)
-        q_act = extract_features(q_raw, fid_extract, batch_size=50)
-        v_act = extract_features(v_raw, fid_extract, batch_size=50)
-        scalars["FID_val"] = FID.fid_from_features(
-            v_act, q_act, cache1=out_dir / "fid_stats_values.npz")
-        # precision/recall on VGG16-fc2 features, like the reference's IPR
-        # (metrics/ipr.py:41) — NOT the Inception activations
-        vgg = VGG16Features()
-        if vgg_params is None:
-            vgg_params = vgg.init(jax.random.key(2),
-                                  jnp.zeros((1, 224, 224, 3)))["params"]
-        vgg_extract = make_extractor(
-            lambda p, x: vgg.apply({"params": p}, x), vgg_params, mesh)
-        # VGG16Features normalizes internally (ImageNet stats) from [0,1]
-        q224 = EvalImageFolder(cfg.query_dir, 224, resize_to=256)
-        v224 = EvalImageFolder(cfg.values_dir, 224, resize_to=256)
-        scalars.update(IPR.precision_recall(
-            extract_features(v224, vgg_extract, batch_size=cfg.batch_size),
-            extract_features(q224, vgg_extract, batch_size=cfg.batch_size)))
+                inception_params = convert_inception_fid(R.retry_call(
+                    lambda: load_torch_file(cfg.inception_weights_path),
+                    attempts=cfg.fault.io_retries, retry_on=(OSError,),
+                    give_up_on=R.NONTRANSIENT_IO, name="load_inception_weights"))
+                _validate_params(
+                    jax.eval_shape(
+                        inception.init, jax.random.key(0),
+                        jax.ShapeDtypeStruct((1, 299, 299, 3), jnp.float32))["params"],
+                    inception_params, "FID Inception")
+            if inception_params is None:
+                inception_params = inception.init(
+                    jax.random.key(1), jnp.zeros((1, 299, 299, 3)))["params"]
+            fid_extract = make_extractor(
+                lambda p, x: inception.apply({"params": p}, x), inception_params, mesh)
+            # reference FID feeds whole (uncropped) images; inception scales inputs
+            q_raw = EvalImageFolder(cfg.query_dir, 299, crop=False)
+            v_raw = EvalImageFolder(cfg.values_dir, 299, crop=False)
+            q_act = extract_features(q_raw, fid_extract, batch_size=50)
+            v_act = extract_features(v_raw, fid_extract, batch_size=50)
+            scalars["FID_val"] = FID.fid_from_features(
+                v_act, q_act, cache1=out_dir / "fid_stats_values.npz")
+            # precision/recall on VGG16-fc2 features, like the reference's IPR
+            # (metrics/ipr.py:41) — NOT the Inception activations
+            vgg = VGG16Features()
+            if vgg_params is None:
+                vgg_params = vgg.init(jax.random.key(2),
+                                      jnp.zeros((1, 224, 224, 3)))["params"]
+            vgg_extract = make_extractor(
+                lambda p, x: vgg.apply({"params": p}, x), vgg_params, mesh)
+            # VGG16Features normalizes internally (ImageNet stats) from [0,1]
+            q224 = EvalImageFolder(cfg.query_dir, 224, resize_to=256)
+            v224 = EvalImageFolder(cfg.values_dir, 224, resize_to=256)
+            scalars.update(IPR.precision_recall(
+                extract_features(v224, vgg_extract, batch_size=cfg.batch_size),
+                extract_features(q224, vgg_extract, batch_size=cfg.batch_size)))
 
     if cfg.galleries and dist.is_primary():
-        _, idx = SIM.topk_matches(sim, cfg.gallery_topk)
-        G.ranked_galleries(query.paths, values.paths, stats.top1, idx,
-                           out_dir / "galleries", rows_per_page=cfg.gallery_rows,
-                           max_rank=cfg.gallery_max_rank)
+        with R.stage("eval/galleries", deadline=stage_deadline):
+            _, idx = SIM.topk_matches(sim, cfg.gallery_topk)
+            G.ranked_galleries(query.paths, values.paths, stats.top1, idx,
+                               out_dir / "galleries", rows_per_page=cfg.gallery_rows,
+                               max_rank=cfg.gallery_max_rank)
 
     writer.scalars(0, {k: v for k, v in scalars.items()
                        if isinstance(v, (int, float))})

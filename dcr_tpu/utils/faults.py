@@ -1,0 +1,136 @@
+"""Deterministic fault injection: the harness that proves recovery works.
+
+A fault spec is an env/config-driven string of comma-separated entries:
+
+    DCR_FAULTS="decode_error@step=3,ckpt_corrupt@step=200,nan_loss@step=5,sigterm@step=7"
+
+Each entry is ``kind@key=value[&key=value...][xN]``: the fault ``kind`` fires
+when a hook point reports coordinates matching EVERY ``key=value`` pair in the
+entry (coordinates the entry doesn't name are ignored), at most ``N`` times
+(default 1). Supported kinds and their hook points:
+
+- ``decode_error`` — DataLoader, per sample; coords ``step``, ``slot``,
+  ``index``, ``epoch``. Simulates a corrupt image: raises
+  :class:`InjectedFault` through the exact code path a real decode failure
+  takes (quarantine + replacement, or fail-fast when the budget is 0).
+- ``ckpt_corrupt`` — CheckpointManager.save, coord ``step``: after the save
+  commits, zero-fills every file in the step directory (a torn/garbage
+  write), so the next restore must fall back.
+- ``nan_loss`` — Trainer loop, coord ``step`` (micro-step): poisons the next
+  observed loss at a log boundary, driving the rollback-or-fail-fast path.
+- ``sigterm`` — Trainer loop, coord ``step``: delivers a real SIGTERM to the
+  process, driving the preemption checkpoint-and-stop path.
+
+The registry is process-global, parsed once from ``DCR_FAULTS`` (tests use
+:func:`install`/:func:`clear`), thread-safe (loader workers fire
+concurrently), and zero-cost when empty — the hot-path guard is one ``None``
+check. Every fired fault emits a structured ``[fault] injected`` log line so
+an injected run is distinguishable from a genuinely failing one.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dcr_tpu.core.resilience import log_event
+
+
+class InjectedFault(RuntimeError):
+    """Raised (or delivered) by an injection hook; never by production code."""
+
+
+_ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<coords>[a-z_]+=\d+(?:&[a-z_]+=\d+)*)"
+                       r"(?:x(?P<times>\d+))?$")
+
+
+@dataclass
+class FaultSpec:
+    kind: str
+    where: dict[str, int]
+    times: int = 1
+    fired: int = 0
+
+    def matches(self, kind: str, coords: dict[str, int]) -> bool:
+        if kind != self.kind or self.fired >= self.times:
+            return False
+        return all(k in coords and coords[k] == v for k, v in self.where.items())
+
+
+def parse_faults(spec: str) -> list[FaultSpec]:
+    """Parse a DCR_FAULTS string; malformed entries fail loudly (a typo'd
+    injection spec silently never firing would invalidate the harness)."""
+    out: list[FaultSpec] = []
+    for entry in (e.strip() for e in spec.split(",") if e.strip()):
+        m = _ENTRY_RE.match(entry)
+        if m is None:
+            raise ValueError(
+                f"malformed fault entry {entry!r} "
+                "(expected kind@key=value[&key=value...][xN])")
+        where = {k: int(v) for k, v in
+                 (pair.split("=") for pair in m.group("coords").split("&"))}
+        out.append(FaultSpec(kind=m.group("kind"), where=where,
+                             times=int(m.group("times") or 1)))
+    return out
+
+
+@dataclass
+class FaultRegistry:
+    specs: list[FaultSpec] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def fire(self, kind: str, **coords: int) -> bool:
+        """True iff a spec matches these coordinates and still has fires left.
+        Firing is atomic: concurrent hook calls can't double-spend a spec."""
+        with self._lock:
+            for s in self.specs:
+                if s.matches(kind, coords):
+                    s.fired += 1
+                    log_event("injected", kind=kind, **coords)
+                    return True
+        return False
+
+    def pending(self) -> list[str]:
+        """Entries that have not exhausted their fires (harness diagnostics)."""
+        with self._lock:
+            return [f"{s.kind}@{s.where} fired {s.fired}/{s.times}"
+                    for s in self.specs if s.fired < s.times]
+
+
+_registry: Optional[FaultRegistry] = None
+
+
+def registry() -> FaultRegistry:
+    """The process-global registry, parsed from DCR_FAULTS on first use."""
+    global _registry
+    if _registry is None:
+        _registry = FaultRegistry(parse_faults(os.environ.get("DCR_FAULTS", "")))
+    return _registry
+
+
+def install(spec: str) -> FaultRegistry:
+    """Replace the global registry (tests / programmatic harnesses)."""
+    global _registry
+    _registry = FaultRegistry(parse_faults(spec))
+    return _registry
+
+
+def clear() -> None:
+    global _registry
+    _registry = None
+
+
+def fire(kind: str, **coords: int) -> bool:
+    """Module-level hook point. Zero-cost when no faults are configured."""
+    global _registry
+    if _registry is None:
+        if not os.environ.get("DCR_FAULTS"):
+            return False
+        registry()
+    return _registry.fire(kind, **coords)

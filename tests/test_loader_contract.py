@@ -1,0 +1,106 @@
+"""DataLoader failure/teardown/resume contract.
+
+Locks in the semantics the fault-tolerance layer builds on: a worker-thread
+error surfaces on the consumer (not swallowed, not hung), teardown after an
+error or early break leaves no live worker threads, and a mid-epoch
+``start_step`` resume reproduces an uninterrupted epoch byte-for-byte —
+the property that makes preemption resume and quarantine replacement
+deterministic.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fast
+from PIL import Image
+
+from dcr_tpu.core.config import DataConfig, FaultToleranceConfig
+from dcr_tpu.data.dataset import ObjectAttributeDataset, SampleDecodeError
+from dcr_tpu.data.loader import DataLoader
+from dcr_tpu.data.tokenizer import HashTokenizer
+
+
+@pytest.fixture()
+def image_folder(tmp_path):
+    rng = np.random.default_rng(0)
+    for cls in ["c0", "c1"]:
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(6):
+            arr = rng.integers(0, 255, (40, 52, 3), np.uint8)
+            Image.fromarray(arr).save(d / f"{cls}_{i}.png")
+    return tmp_path / "data"
+
+
+def _dataset(root, **fault_kw):
+    cfg = DataConfig(train_data_dir=str(root), resolution=32,
+                     class_prompt="nolevel", num_workers=2, seed=7)
+    # no backoff sleeps in tests
+    ft = FaultToleranceConfig(retry_base_delay=0.0, retry_max_delay=0.0,
+                              **fault_kw)
+    return ObjectAttributeDataset(cfg, HashTokenizer(100, 16), fault=ft)
+
+
+def _corrupt(ds, position: int) -> int:
+    """Overwrite the image at dataset position with garbage; returns index."""
+    index = int(ds.active_indices[position])
+    with open(ds.paths[index], "wb") as f:
+        f.write(b"this is not an image at all")
+    return index
+
+
+def test_worker_error_surfaces_on_consumer(image_folder):
+    ds = _dataset(image_folder)
+    bad = _corrupt(ds, 3)
+    loader = DataLoader(ds, batch_size=2, num_workers=2, seed=1)
+    with pytest.raises(SampleDecodeError) as ei:
+        for _ in loader.epoch(0):
+            pass
+    assert ei.value.index == bad
+    assert ds.paths[bad] in str(ei.value)
+
+
+def test_teardown_after_worker_error_leaves_no_threads(image_folder):
+    ds = _dataset(image_folder)
+    _corrupt(ds, 0)
+    before = threading.active_count()
+    loader = DataLoader(ds, batch_size=2, num_workers=4, seed=1, prefetch=2)
+    with pytest.raises(SampleDecodeError):
+        for _ in loader.epoch(0):
+            pass
+    deadline = time.time() + 5.0
+    while threading.active_count() > before + 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
+
+
+def test_teardown_after_early_break_leaves_no_threads(image_folder):
+    ds = _dataset(image_folder)
+    before = threading.active_count()
+    loader = DataLoader(ds, batch_size=1, num_workers=4, seed=1, prefetch=2)
+    it = loader.epoch(0)
+    next(it)
+    it.close()  # generator finally -> stop event -> workers drain and exit
+    deadline = time.time() + 5.0
+    while threading.active_count() > before + 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
+
+
+def test_start_step_resume_is_byte_identical(image_folder):
+    """Resume at every possible start_step reproduces the uninterrupted
+    epoch's remaining batches exactly — pixels, token ids, and indices."""
+    ds = _dataset(image_folder)
+    loader = DataLoader(ds, batch_size=3, num_workers=3, seed=5)
+    full = list(loader.epoch(2))
+    assert len(full) == loader.steps_per_epoch()
+    for start in range(1, len(full)):
+        resumed = list(loader.epoch(2, start_step=start))
+        assert len(resumed) == len(full) - start
+        for got, want in zip(resumed, full[start:]):
+            np.testing.assert_array_equal(got.pixel_values, want.pixel_values)
+            np.testing.assert_array_equal(got.input_ids, want.input_ids)
+            np.testing.assert_array_equal(got.index, want.index)

@@ -21,6 +21,10 @@ ROUND="${ROUND:-4}"
 TAG="${TAG:-a}"
 ONLY="${ONLY:-}"
 LOG="measure_all_r${ROUND}${TAG}.log"
+# per-stage completion sentinels: tools/tpu_watch.sh narrows a retry to
+# ONLY=bench only when every other stage banked its artifact on a prior pass
+SENTINEL_DIR=".measure_done_r${ROUND}"
+mkdir -p "$SENTINEL_DIR"
 
 run() { # name timeout_s cmd...
   local name="$1" t="$2"; shift 2
@@ -37,12 +41,13 @@ run bench     5400 env BENCH_TIME_BUDGET_SECS=4800 BENCH_TIMEOUT_SECS=2400 pytho
 BENCH_RC=$?
 cp -f BENCH_PROGRESS.json "BENCH_PROGRESS_r${ROUND}${TAG}.json" 2>/dev/null
 if [ "$ONLY" != "bench" ]; then
-  run sweep     2400 python tools/sweep_flash.py
-  run crosscheck 1800 python tools/check_flash_timing.py
-  run sample    1800 python tools/bench_sample.py
+  run sweep     2400 python tools/sweep_flash.py           && touch "$SENTINEL_DIR/sweep"
+  run crosscheck 1800 python tools/check_flash_timing.py   && touch "$SENTINEL_DIR/crosscheck"
+  run sample    1800 python tools/bench_sample.py          && touch "$SENTINEL_DIR/sample"
   # trace is additive diagnostics (never the number of record — tracing
   # perturbs timing); a wedge here must not eat the banked results above
-  run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}${TAG}"
+  run profile    900 python tools/capture_profile.py 3 16 "profile_trace_r${ROUND}${TAG}" \
+                                                           && touch "$SENTINEL_DIR/profile"
 fi
 
 echo "=== done; snapshot: BENCH_PROGRESS_r${ROUND}${TAG}.json ===" | tee -a "$LOG"

@@ -46,7 +46,15 @@ EOF
     FIRES=$((FIRES + 1))
     only=""
     if [ "$FIRES" -gt 1 ]; then
-      only="bench"      # retries re-run only the stage of record
+      # narrow a retry to the bench stage of record ONLY when every other
+      # stage banked its artifact on a previous pass (per-stage sentinels
+      # written by measure_all.sh) — a first pass that died before
+      # sweep/crosscheck/sample/profile ran must re-run the full ladder
+      sdir=".measure_done_r${ROUND}"
+      if [ -e "$sdir/sweep" ] && [ -e "$sdir/crosscheck" ] \
+          && [ -e "$sdir/sample" ] && [ -e "$sdir/profile" ]; then
+        only="bench"
+      fi
     fi
     echo "{\"ts\": \"$(date -u +%Y-%m-%dT%H:%M:%SZ)\", \"event\": \"tpu_alive_firing_measure_all\", \"attempt\": $FIRES, \"only\": \"$only\"}" >> "$LOG"
     # bounded above the sum of measure_all's own stage budgets (~12300s), so
