@@ -34,6 +34,15 @@ def main(argv=None) -> None:
     if reg and reg.pending():
         log.warning("fault entries never fired (check coordinates): %s",
                     reg.pending())
+    if trainer.preempted_exit:
+        from dcr_tpu.core.coordination import EXIT_PREEMPTED
+
+        # distinct, deliberate exit code: the restart wrapper can tell "final
+        # checkpoint written, restart me" (EXIT_PREEMPTED) apart from both
+        # success (0) and a crash — every rank of a pod exits with it together
+        log.warning("preempted: final checkpoint written; exiting with code "
+                    "%d for the restart wrapper", EXIT_PREEMPTED)
+        raise SystemExit(EXIT_PREEMPTED)
     log.info("training done: %s", metrics)
 
 

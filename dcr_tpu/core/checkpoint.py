@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
+from dcr_tpu.core import dist
 from dcr_tpu.core import resilience as R
 
 log = logging.getLogger("dcr_tpu")
@@ -53,18 +54,38 @@ def _leaf_key(path) -> str:
     return jax.tree_util.keystr(path)
 
 
+def _host_view(leaf: Any) -> tuple[np.ndarray, tuple, str]:
+    """(host bytes, GLOBAL shape, dtype) of a leaf for checksumming.
+
+    Fully-addressable or fully-replicated arrays fetch whole. A multi-host
+    sharded array contributes only this host's addressable shards,
+    concatenated in device-placement order — deterministic for a fixed
+    sharding, so the per-process manifest written at save time verifies the
+    same host's restore (trainers shard state identically across a run)."""
+    if (isinstance(leaf, jax.Array) and not leaf.is_fully_addressable
+            and not leaf.is_fully_replicated):
+        shards = sorted(leaf.addressable_shards,
+                        key=lambda s: tuple(sl.start or 0 for sl in s.index))
+        flat = np.concatenate([np.asarray(s.data).ravel() for s in shards])
+        return flat, tuple(leaf.shape), str(leaf.dtype)
+    arr = np.asarray(jax.device_get(leaf))
+    return arr, tuple(arr.shape), str(arr.dtype)
+
+
 def state_manifest(state: Any) -> dict:
     """Flattened-tree content manifest: per-leaf crc32 of the host bytes plus
     shape/dtype. crc32 is not cryptographic — the adversary is a torn write or
-    bit rot, not tampering — and costs ~1GB/s on one core."""
+    bit rot, not tampering — and costs ~1GB/s on one core. Multi-host: each
+    process manifests its own addressable view (see :func:`_host_view`) into
+    its own per-process file, so no host ever touches non-addressable data."""
     leaves = {}
     flat, _ = jax.tree_util.tree_flatten_with_path(state)
     for path, leaf in flat:
-        arr = np.asarray(jax.device_get(leaf))
+        arr, shape, dtype = _host_view(leaf)
         leaves[_leaf_key(path)] = {
             "crc32": zlib.crc32(np.ascontiguousarray(arr).tobytes()),
-            "shape": list(arr.shape),
-            "dtype": str(arr.dtype),
+            "shape": list(shape),
+            "dtype": dtype,
         }
     return {"format": MANIFEST_FORMAT, "leaves": leaves}
 
@@ -83,9 +104,9 @@ def verify_manifest(manifest: dict, state: Any) -> list[str]:
         if want is None:
             problems.append(f"{key}: leaf not in manifest")
             continue
-        arr = np.asarray(jax.device_get(leaf))
-        if list(arr.shape) != want["shape"] or str(arr.dtype) != want["dtype"]:
-            problems.append(f"{key}: shape/dtype {arr.shape}/{arr.dtype} != "
+        arr, shape, dtype = _host_view(leaf)
+        if list(shape) != want["shape"] or dtype != want["dtype"]:
+            problems.append(f"{key}: shape/dtype {shape}/{dtype} != "
                             f"{want['shape']}/{want['dtype']}")
         elif zlib.crc32(np.ascontiguousarray(arr).tobytes()) != want["crc32"]:
             problems.append(f"{key}: checksum mismatch")
@@ -98,26 +119,37 @@ class CheckpointManager:
     """Checkpoint manager with per-step integrity manifests and
     quarantine-and-fall-back restore, over one of two storage backends:
 
-    - **orbax** (TPU/GPU, and any multi-process job): async by default so the
-      accelerator never idles on host I/O; sharded tensorstore writes.
-    - **npz** (single-process CPU): one ``<step>/state.npz`` per step,
+    - **orbax** (TPU/GPU): async by default so the accelerator never idles on
+      host I/O; sharded tensorstore writes (collective across processes).
+    - **npz** (CPU, any process count): one ``<step>/state.npz`` per step,
       committed by atomic directory rename. The orbax/tensorstore native
       stack is memory-unsafe on the CPU backend in this environment
       (use-after-free heap aborts — glibc 'corrupted size vs. prev_size' —
       and checkpoints silently containing later-step bytes, both caught by
       the content manifests); CPU runs are tests/smoke only, so a plain
       numpy format loses nothing and removes every native thread from the
-      path. Both backends share the same manifest/quarantine semantics.
+      path. Multi-process CPU (the coordination tests' regime): process 0
+      writes the replicated state, every process joins a commit barrier, and
+      restore rebuilds global arrays from the shared file. Both backends
+      share the same manifest/quarantine semantics.
+
+    Multi-host: pass a ``coordinator`` (core/coordination.py) and
+    :meth:`restore_latest_valid` AGREES the fallback choice across hosts —
+    each round proposes the newest local step, takes the pod-wide minimum,
+    validates it everywhere, and only returns when every host restored the
+    same step; a step any host rejects is quarantined pod-wide.
     """
 
     def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
                  async_save: bool = True, verify: bool = True,
-                 quarantine: Optional[R.QuarantineManifest] = None):
+                 quarantine: Optional[R.QuarantineManifest] = None,
+                 coordinator: Optional[Any] = None):
         self._dir = Path(directory).absolute()
         self._dir.mkdir(parents=True, exist_ok=True)
-        self._npz = (jax.default_backend() == "cpu"
-                     and jax.process_count() == 1)
+        self._npz = jax.default_backend() == "cpu"
         self._max_to_keep = max_to_keep
+        self._coordinator = coordinator
+        self._barrier_timeout = float(getattr(coordinator, "timeout_s", 0.0) or 0.0)
         if self._npz:
             self._mgr = None
         else:
@@ -138,24 +170,58 @@ class CheckpointManager:
                       and (d / "state.npz").exists())
 
     def _npz_save(self, step: int, state: Any) -> bool:
-        flat, _ = jax.tree_util.tree_flatten_with_path(state)
-        arrays = {_leaf_key(path): np.asarray(jax.device_get(leaf))
-                  for path, leaf in flat}
-        tmp = self._dir / f"{step}.tmp-npz"
-        if tmp.exists():
-            shutil.rmtree(tmp)
-        tmp.mkdir(parents=True)
-        np.savez(tmp / "state.npz", **arrays)
-        tmp.replace(self._dir / str(step))  # atomic commit
-        # retention, oldest first (matches orbax max_to_keep)
-        steps = self._npz_steps()
-        for old in steps[: max(0, len(steps) - self._max_to_keep)]:
-            shutil.rmtree(self._dir / str(old), ignore_errors=True)
+        # Barrier discipline on >1 process: every rank reaches the SAME
+        # barriers in the SAME order no matter what the writer does, or the
+        # pod deadlocks. Writer errors are deferred past the commit barrier.
+        error: Optional[BaseException] = None
+        if jax.process_index() == 0:
+            try:
+                flat, _ = jax.tree_util.tree_flatten_with_path(state)
+                arrays = {}
+                for path, leaf in flat:
+                    if (isinstance(leaf, jax.Array)
+                            and not leaf.is_fully_addressable
+                            and not leaf.is_fully_replicated):
+                        raise CheckpointCorrupt(
+                            f"npz backend cannot save host-sharded leaf "
+                            f"{_leaf_key(path)} (multi-process CPU requires "
+                            "replicated state; use the orbax backend)")
+                    arrays[_leaf_key(path)] = np.asarray(jax.device_get(leaf))
+                tmp = self._dir / f"{step}.tmp-npz"
+                if tmp.exists():
+                    shutil.rmtree(tmp)
+                tmp.mkdir(parents=True)
+                np.savez(tmp / "state.npz", **arrays)
+                tmp.replace(self._dir / str(step))  # atomic commit
+                # retention, oldest first (matches orbax max_to_keep)
+                steps = self._npz_steps()
+                for old in steps[: max(0, len(steps) - self._max_to_keep)]:
+                    shutil.rmtree(self._dir / str(old), ignore_errors=True)
+            except BaseException as e:
+                error = e
+        if jax.process_count() > 1:
+            # commit outcome agreement: peers must not report (or act on)
+            # saved=True for a step the writer failed to commit — on the
+            # preemption path that would exit EXIT_PREEMPTED claiming a final
+            # checkpoint that does not exist. Doubles as the commit barrier:
+            # no host proceeds before the write is visible on the shared fs.
+            oks = dist.kv_allgather(str(int(error is None)),
+                                    f"ckpt_save_ok:{step}",
+                                    timeout_s=self._barrier_timeout)
+            if oks[0] != "1":  # the writer (rank 0) reported failure
+                if error is not None:
+                    raise error
+                raise CheckpointCorrupt(
+                    f"step {step}: primary host failed to commit the npz "
+                    f"checkpoint (see its log); refusing to report saved")
+        elif error is not None:
+            raise error
         return True
 
     def _npz_restore(self, step: int, state_like: Any) -> Any:
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_like)
         leaves = []
+        multiproc = jax.process_count() > 1
         with np.load(self._dir / str(step) / "state.npz") as z:
             for path, like in flat:
                 key = _leaf_key(path)
@@ -169,14 +235,27 @@ class CheckpointManager:
                         f"step {step}: leaf {key} is {arr.shape}/{arr.dtype}, "
                         f"expected {tuple(like.shape)}/{like.dtype}")
                 sharding = getattr(like, "sharding", None)
-                leaves.append(jax.device_put(arr, sharding)
-                              if sharding is not None else jnp.asarray(arr))
+                if sharding is not None and multiproc:
+                    # global array spanning processes: every host read the
+                    # shared file, each contributes its addressable pieces
+                    leaves.append(jax.make_array_from_callback(
+                        tuple(like.shape), sharding,
+                        lambda idx, a=arr: a[idx]))
+                elif sharding is not None:
+                    leaves.append(jax.device_put(arr, sharding))
+                else:
+                    leaves.append(jnp.asarray(arr))
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
     # -- manifests -----------------------------------------------------------
 
     def _manifest_path(self, step: int) -> Path:
-        return self._manifest_dir / f"{step}.json"
+        # one manifest file per process: each host checksums only its own
+        # addressable view (see _host_view), and a shared filesystem never
+        # sees two hosts racing writes to the same path
+        if jax.process_count() == 1:
+            return self._manifest_dir / f"{step}.json"
+        return self._manifest_dir / f"{step}.p{jax.process_index()}.json"
 
     def _write_manifest(self, step: int, state: Any) -> None:
         # written synchronously BEFORE the async orbax save: a crash mid-save
@@ -201,14 +280,22 @@ class CheckpointManager:
             live.add(keep)  # the in-flight async save may not be listed yet
         for mf in self._manifest_dir.glob("*.json"):
             try:
-                if int(mf.stem) not in live:
-                    mf.unlink()
+                # stems are "<step>" or "<step>.p<rank>" (per-process)
+                if int(mf.stem.split(".")[0]) not in live:
+                    mf.unlink(missing_ok=True)  # peers prune concurrently
             except ValueError:
                 continue
 
     # -- save/restore --------------------------------------------------------
 
     def save(self, step: int, state: Any, *, force: bool = False) -> bool:
+        if self._npz and jax.process_count() > 1:
+            # align views BEFORE the existence check: without this, a rank
+            # arriving after the primary's commit would take the idempotent
+            # early return below while the primary waits alone at the commit
+            # barrier inside _npz_save — a pod deadlock
+            dist.barrier(f"ckpt_save_enter:{step}",
+                         timeout_s=self._barrier_timeout)
         if step in self.all_steps():
             return False  # idempotent: final save may coincide with a periodic one
         if self._verify:
@@ -264,13 +351,40 @@ class CheckpointManager:
                         f"({len(problems)} mismatches): {'; '.join(problems[:5])}")
         return state
 
+    def _try_restore_verified(self, step: int, state_like: Any) -> tuple[bool, Any]:
+        """(True, state) when ``step`` restores and passes its manifest;
+        (False, reason) otherwise. Never raises on a bad step."""
+        try:
+            state = self._backend_restore(step, state_like)
+            manifest = self._load_manifest(step) if self._verify else None
+            if manifest is None:
+                if self._verify:
+                    log.info("checkpoint step %d has no manifest "
+                             "(pre-manifest save): accepted unverified", step)
+                return True, state
+            problems = verify_manifest(manifest, state)
+            if not problems:
+                return True, state
+            return False, f"verification failed: {'; '.join(problems[:3])}"
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # orbax raises many types on torn dirs
+            return False, f"restore raised: {e!r}"
+
     def restore_latest_valid(self, state_like: Any) -> tuple[Any, int, list[tuple[int, str]]]:
         """(state, step, skipped): walk ``all_steps()`` newest-first to the
         newest checkpoint that restores AND verifies; quarantine every bad
         step on the way (moved to ``quarantined/<step>``, recorded, logged) so
         it is never retried. Raises FileNotFoundError only when no valid
-        checkpoint exists at all."""
+        checkpoint exists at all.
+
+        Multi-host (a coordinator was supplied): the choice is AGREED — see
+        :meth:`_restore_latest_valid_coordinated` — so every host resumes from
+        the identical step even when hosts observe different corruption."""
         self.wait()
+        if (self._coordinator is not None
+                and getattr(self._coordinator, "process_count", 1) > 1):
+            return self._restore_latest_valid_coordinated(state_like)
         skipped: list[tuple[int, str]] = []
         while True:
             steps = sorted(self.all_steps(), reverse=True)
@@ -281,32 +395,49 @@ class CheckpointManager:
                         f"{len(skipped)} steps quarantined ({skipped})")
                 raise FileNotFoundError(f"no checkpoints under {self._dir}")
             step = steps[0]
-            reason: str
-            try:
-                state = self._backend_restore(step, state_like)
-                manifest = self._load_manifest(step) if self._verify else None
-                if manifest is None:
-                    if self._verify:
-                        log.info("checkpoint step %d has no manifest "
-                                 "(pre-manifest save): accepted unverified", step)
-                    return state, step, skipped
-                problems = verify_manifest(manifest, state)
-                if not problems:
-                    return state, step, skipped
-                reason = f"verification failed: {'; '.join(problems[:3])}"
-            except (KeyboardInterrupt, SystemExit):
-                raise
-            except Exception as e:  # orbax raises many types on torn dirs
-                reason = f"restore raised: {e!r}"
-            self._quarantine_step(step, reason)
-            skipped.append((step, reason))
+            ok, payload = self._try_restore_verified(step, state_like)
+            if ok:
+                return payload, step, skipped
+            self._quarantine_step(step, payload)
+            skipped.append((step, payload))
+
+    def _restore_latest_valid_coordinated(self, state_like: Any) -> tuple[Any, int, list[tuple[int, str]]]:
+        """Pod-wide agreement loop: each round every host proposes its newest
+        available step, the pod takes the minimum (the newest step EVERY host
+        can see), every host validates that step, and a second agreement
+        confirms all hosts succeeded. A step any host rejects is quarantined
+        everywhere (concurrent moves on a shared filesystem are tolerated)
+        and the loop re-proposes — so divergent local corruption can never
+        make two hosts resume from different steps."""
+        coord = self._coordinator
+        skipped: list[tuple[int, str]] = []
+        while True:
+            steps = self.all_steps()
+            candidate = max(steps) if steps else -1
+            proposals = coord.agree_int(candidate, "ckpt_candidate")
+            agreed = min(proposals)
+            if agreed < 0:
+                raise FileNotFoundError(
+                    f"no checkpoint available on every host under {self._dir}: "
+                    f"per-rank proposals {proposals}, skipped {skipped}")
+            ok, payload = self._try_restore_verified(agreed, state_like)
+            oks = coord.agree_int(int(ok), "ckpt_valid")
+            if all(oks):
+                return payload, agreed, skipped
+            reason = (payload if not ok else
+                      f"peer host failed validation of step {agreed} (oks={oks})")
+            self._quarantine_step(agreed, reason)
+            skipped.append((agreed, reason))
 
     def _quarantine_step(self, step: int, reason: str) -> None:
         src = self._dir / str(step)
         dst = self._dir / "quarantined" / str(step)
         dst.parent.mkdir(parents=True, exist_ok=True)
-        if src.exists():
-            shutil.move(str(src), str(dst))
+        if src.exists() and not dst.exists():
+            try:
+                shutil.move(str(src), str(dst))
+            except OSError as e:  # a peer host on the shared fs moved it first
+                log.info("quarantine move of step %d raced a peer: %r", step, e)
         if self._mgr is not None:
             self._mgr.reload()  # drop the moved step from orbax's cached list
         R.log_event("ckpt_quarantined", step=step, reason=reason,

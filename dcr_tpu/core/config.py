@@ -216,6 +216,17 @@ class FaultToleranceConfig:
     # soft per-stage time budget for eval pipeline stages (watchdog warning
     # only; 0 disables)
     stage_deadline_secs: float = 0.0
+    # multi-host: wall-clock budget for cross-host sync points (barriers and
+    # fault-agreement allgathers); overrun raises a typed BarrierTimeout
+    # instead of hanging forever. 0 = wait forever (single-host default).
+    barrier_timeout_s: float = 0.0
+    # multi-host: collective-hang watchdog — no step-boundary heartbeat for
+    # this long => dump all thread stacks + the last agreement word and abort
+    # with exit code 89 (coordination.EXIT_HANG) so the scheduler restarts the
+    # pod instead of letting it stall. 0 = disabled; env DCR_HANG_TIMEOUT_S
+    # overrides (set it comfortably above the slowest legitimate step gap,
+    # including eval/sampling pauses).
+    hang_timeout_s: float = 0.0
 
 
 @dataclass

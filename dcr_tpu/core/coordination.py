@@ -1,0 +1,380 @@
+"""Distributed resilience coordinator: pod-safe recovery decisions.
+
+PR 1's fault-tolerance layer (quarantine, NaN rollback, checkpoint fallback,
+preemption checkpointing) made every recovery decision per-process. On a
+multi-host pod that is fatal: one host rolling back or stopping while its
+peers continue means divergent batch streams and a hung all-reduce — the pod
+stalls until the scheduler kills it. This module makes every recovery path
+a *pod-level* decision:
+
+- **Fault-agreement protocol** — at each step boundary hosts allgather a
+  compact :class:`FaultWord` (nan_step, rollback_ok, preempt, bad_samples)
+  and reduce it with the pure, deterministic :func:`reduce_fault_words`, so
+  every host takes the identical :class:`Action` at the identical step.
+- **Coordinated preemption** — SIGTERM/SIGINT on any host sets the preempt
+  bit; the agreement turns it into one synchronized final checkpoint and a
+  uniform exit with :data:`EXIT_PREEMPTED`, which a restart wrapper can
+  distinguish from both success and a crash.
+- **Collective-hang watchdog** — :class:`HangWatchdog` is a per-host
+  heartbeat thread: when the train loop stops beating (a peer died inside a
+  collective, an injected ``hang`` fault, a wedged host thread) it dumps
+  every Python thread stack plus the last agreement word to the structured
+  log and aborts with :data:`EXIT_HANG` instead of hanging until the
+  scheduler's timeout. Agreement collectives themselves run under
+  :func:`dcr_tpu.core.dist.run_with_timeout` so a blocked allgather trips
+  the same abort.
+
+The agreement word is intentionally tiny (one int64 vector per host per log
+boundary over DCN) and the reduce is pure so it can be unit-tested without
+subprocesses; the 2-process end-to-end proof lives in
+tests/test_coordination.py.
+"""
+
+from __future__ import annotations
+
+import enum
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import dist
+from dcr_tpu.core.resilience import log_event
+
+log = logging.getLogger("dcr_tpu")
+
+# Exit codes a restart wrapper can branch on. Chosen outside the shell's
+# reserved ranges (1/2, 126-165) so they are unambiguous in `$?`:
+# EXIT_PREEMPTED means "final checkpoint written, restart me";
+# EXIT_HANG means "a collective hung — inspect the stack dump, then restart".
+EXIT_PREEMPTED = 83
+EXIT_HANG = 89
+
+# monkeypatchable so tests can observe aborts without dying
+_exit_fn = os._exit
+
+
+class CoordinationError(RuntimeError):
+    """Hosts disagree on state that must be identical (e.g. resume step)."""
+
+
+class Action(enum.Enum):
+    CONTINUE = "continue"
+    ROLLBACK = "rollback"                  # all hosts restore the same checkpoint
+    FAIL = "fail"                          # all hosts fail fast together (NaN, no rollback)
+    CHECKPOINT_AND_EXIT = "checkpoint_and_exit"
+    ABORT_BAD_SAMPLES = "abort_bad_samples"
+
+
+_WORD_LEN = 4
+
+
+@dataclass
+class FaultWord:
+    """One host's contribution to the agreement: fixed-width, order-stable."""
+
+    nan_step: int = -1        # step whose observed loss went non-finite; -1 = none
+    rollback_ok: bool = False  # this host could roll back (budget + checkpoint exist)
+    preempt: bool = False      # SIGTERM/SIGINT seen on this host
+    bad_samples: int = 0       # bad samples quarantined this epoch on this host
+
+    def encode(self) -> np.ndarray:
+        return np.asarray([self.nan_step, int(self.rollback_ok),
+                           int(self.preempt), self.bad_samples], np.int64)
+
+    @staticmethod
+    def decode(vec: Sequence[int]) -> "FaultWord":
+        vec = np.asarray(vec).reshape(-1)
+        if vec.size != _WORD_LEN:
+            raise ValueError(f"fault word must have {_WORD_LEN} fields, got {vec.size}")
+        return FaultWord(nan_step=int(vec[0]), rollback_ok=bool(vec[1]),
+                         preempt=bool(vec[2]), bad_samples=int(vec[3]))
+
+
+@dataclass(frozen=True)
+class Decision:
+    """The reduced, pod-identical outcome of one agreement round."""
+
+    action: Action
+    nan_step: int = -1
+    nan_ranks: tuple = ()
+    preempt_ranks: tuple = ()
+    bad_total: int = 0
+
+
+def reduce_fault_words(words: Sequence[FaultWord], *,
+                       bad_budget: Optional[int] = None) -> Decision:
+    """Deterministically reduce one word per host into a single Decision.
+
+    Precedence (every host computes the same thing from the same words):
+
+    1. any ``nan_step >= 0`` → ROLLBACK to the *earliest* reported step when
+       every NaN-reporting host can roll back, else FAIL — a NaN must never
+       be checkpointed, so it outranks preemption;
+    2. any ``preempt`` → CHECKPOINT_AND_EXIT (progress is preserved even when
+       the bad-sample budget is also blown — the restart will re-judge);
+    3. global bad-sample total over ``bad_budget`` → ABORT_BAD_SAMPLES
+       (per-host budgets can each be under the line while the pod as a whole
+       is training on garbage);
+    4. otherwise CONTINUE.
+    """
+    nan_ranks = tuple(i for i, w in enumerate(words) if w.nan_step >= 0)
+    preempt_ranks = tuple(i for i, w in enumerate(words) if w.preempt)
+    bad_total = int(sum(w.bad_samples for w in words))
+    if nan_ranks:
+        step = min(words[i].nan_step for i in nan_ranks)
+        ok = all(words[i].rollback_ok for i in nan_ranks)
+        return Decision(Action.ROLLBACK if ok else Action.FAIL, nan_step=step,
+                        nan_ranks=nan_ranks, preempt_ranks=preempt_ranks,
+                        bad_total=bad_total)
+    if preempt_ranks:
+        return Decision(Action.CHECKPOINT_AND_EXIT, preempt_ranks=preempt_ranks,
+                        bad_total=bad_total)
+    if bad_budget is not None and bad_total > bad_budget:
+        return Decision(Action.ABORT_BAD_SAMPLES, bad_total=bad_total)
+    return Decision(Action.CONTINUE, bad_total=bad_total)
+
+
+class Coordinator:
+    """Per-process handle on the fault-agreement protocol.
+
+    Local fault observations accumulate via ``note_*``; :meth:`exchange`
+    allgathers them (a no-collective fast path on one host) and returns the
+    pod-identical :class:`Decision`. The transport is the coordination
+    service's KV store (:func:`dcr_tpu.core.dist.kv_allgather`) — pure gRPC,
+    no XLA — so agreements work on every backend, before the first compiled
+    step, and while a device collective is wedged; tests may inject a plain
+    ``vec -> rows`` allgather instead. Every round runs under the configured
+    timeout; a timeout either aborts the process with :data:`EXIT_HANG`
+    (``abort_on_timeout=True``, the trainer's watchdog contract) or
+    re-raises :class:`~dcr_tpu.core.dist.BarrierTimeout`.
+    """
+
+    def __init__(self, *, process_index: Optional[int] = None,
+                 process_count: Optional[int] = None,
+                 allgather: Optional[Callable[[np.ndarray], np.ndarray]] = None,
+                 timeout_s: float = 0.0, abort_on_timeout: bool = False,
+                 bad_sample_budget: Optional[int] = None):
+        import jax
+
+        self.process_index = (jax.process_index() if process_index is None
+                              else process_index)
+        self.process_count = (jax.process_count() if process_count is None
+                              else process_count)
+        self.allgather = allgather  # None => coordination-service KV store
+        self.timeout_s = float(timeout_s)
+        self.abort_on_timeout = abort_on_timeout
+        self.bad_sample_budget = bad_sample_budget
+        self._word = FaultWord()
+        self.last_agreement: Optional[dict] = None  # dumped by hang_abort
+        global _active_coordinator
+        _active_coordinator = self  # hang post-mortems find the newest one
+
+    # -- local observations --------------------------------------------------
+
+    def note_nan(self, step: int, *, rollback_ok: bool) -> None:
+        self._word.nan_step = int(step)
+        self._word.rollback_ok = bool(rollback_ok)
+
+    def note_preempt(self) -> None:
+        self._word.preempt = True           # sticky: preemption never un-happens
+
+    def note_bad_samples(self, count: int) -> None:
+        self._word.bad_samples = int(count)  # absolute per-epoch count, not a delta
+
+    # -- collectives ---------------------------------------------------------
+
+    def _gather_ints(self, values: Sequence[int], tag: str) -> list[list[int]]:
+        """One control-plane allgather round: each host contributes a small
+        int vector, every host gets all of them in rank order. Timeouts obey
+        the abort_on_timeout contract."""
+        try:
+            if self.allgather is not None:  # injected transport (tests)
+                rows = dist.run_with_timeout(
+                    lambda: self.allgather(np.asarray(values, np.int64)),
+                    self.timeout_s, name=f"agree:{tag}")
+                return [[int(x) for x in np.asarray(row).reshape(-1)]
+                        for row in np.asarray(rows).reshape(self.process_count, -1)]
+            payload = ",".join(str(int(v)) for v in values)
+            rows = dist.kv_allgather(payload, tag, timeout_s=self.timeout_s)
+            return [[int(x) for x in row.split(",")] for row in rows]
+        except dist.BarrierTimeout as e:
+            if self.abort_on_timeout:
+                hang_abort(tag, coordinator=self, detail=str(e))
+            raise
+
+    def exchange(self, step: int, tag: str = "sync") -> Decision:
+        """One agreement round. Collective on >1 process; pure on one."""
+        word = self._word
+        if self.process_count == 1:
+            words = [word]
+        else:
+            rows = self._gather_ints([int(x) for x in word.encode()],
+                                     f"word:{tag}")
+            words = [FaultWord.decode(r) for r in rows]
+        decision = reduce_fault_words(words, bad_budget=self.bad_sample_budget)
+        self.last_agreement = {
+            "step": int(step), "tag": tag, "local_word": vars(word).copy(),
+            "action": decision.action.value, "nan_step": decision.nan_step,
+            "preempt_ranks": list(decision.preempt_ranks),
+            "bad_total": decision.bad_total,
+        }
+        # nan is one-shot (handled right after the exchange); preempt stays
+        # sticky; bad_samples is an absolute count refreshed by the caller
+        self._word = FaultWord(preempt=word.preempt, bad_samples=word.bad_samples)
+        if decision.action is not Action.CONTINUE:
+            log_event("agreement", **self.last_agreement)
+        return decision
+
+    def agree_int(self, value: int, name: str) -> list[int]:
+        """Allgather one int per host (checkpoint-step agreement etc.)."""
+        if self.process_count == 1:
+            return [int(value)]
+        return [row[0] for row in self._gather_ints([int(value)], f"int:{name}")]
+
+    def assert_same(self, name: str, value: int) -> None:
+        """Fail fast (typed, diagnosable) when hosts disagree on a value that
+        must be pod-identical — e.g. the resume step after restore."""
+        values = self.agree_int(value, name)
+        if len(set(values)) > 1:
+            raise CoordinationError(
+                f"hosts disagree on {name}: per-rank values {values} — "
+                "refusing to start collectives from divergent state")
+
+
+# ---------------------------------------------------------------------------
+# Collective-hang watchdog
+# ---------------------------------------------------------------------------
+
+def dump_stacks() -> str:
+    """Every live Python thread's stack, for the hang post-mortem."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    parts = []
+    for ident, frame in sys._current_frames().items():
+        header = f"--- thread {names.get(ident, '?')} (id {ident}) ---"
+        parts.append(header + "\n" + "".join(traceback.format_stack(frame)))
+    return "\n".join(parts)
+
+
+_active_coordinator: Optional["Coordinator"] = None
+_abort_guard = threading.Lock()
+_abort_started = False
+
+
+def hang_abort(name: str, *, coordinator: Optional[Coordinator] = None,
+               detail: str = "") -> None:
+    """Structured post-mortem (thread stacks + last agreement word), then a
+    hard exit with the distinct hang code. os._exit, not sys.exit: the main
+    thread is typically wedged inside a native collective and cannot unwind.
+
+    Exit ORDER matters on a pod: the coordination service lives in process 0,
+    and jaxlib's client terminates every survivor with an undiagnosable
+    SIGABRT the instant the service's socket closes — so process 0 delays
+    its own exit by one watchdog window, letting every peer reach its own
+    hang_abort (clean EXIT_HANG + stack dump) before the service goes away.
+    Non-leader deaths propagate only via slow heartbeats, so peers exiting
+    first never take the leader down prematurely."""
+    global _abort_started
+    with _abort_guard:
+        if _abort_started:
+            # another thread (watchdog vs. collective timeout) is already
+            # finishing the abort; park forever rather than racing it
+            while True:  # pragma: no cover - parked until _exit
+                time.sleep(60)
+        _abort_started = True
+    coordinator = coordinator or _active_coordinator
+    last = coordinator.last_agreement if coordinator is not None else None
+    log_event("hang_abort", name=name, detail=detail, exit_code=EXIT_HANG,
+              last_agreement=last)
+    log.error("collective-hang watchdog: aborting %r with exit code %d; "
+              "thread stacks:\n%s", name, EXIT_HANG, dump_stacks())
+    import jax
+
+    if jax.process_count() > 1 and jax.process_index() == 0:
+        timeout = coordinator.timeout_s if coordinator is not None else 0.0
+        grace = min(60.0, timeout / 4 + 5.0) if timeout > 0 else 10.0
+        log.error("leader (process 0) delaying exit %.1fs so peers abort "
+                  "with their own post-mortems first", grace)
+        sys.stderr.flush()
+        sys.stdout.flush()
+        time.sleep(grace)
+    sys.stderr.flush()
+    sys.stdout.flush()
+    _exit_fn(EXIT_HANG)
+    with _abort_guard:  # only reachable when tests stub out _exit_fn
+        _abort_started = False
+
+
+class HangWatchdog:
+    """Heartbeat monitor: the train loop calls :meth:`beat` at every step
+    boundary; when beats stop for longer than ``timeout_s`` the monitor thread
+    fires :func:`hang_abort`. Arms on the FIRST beat, so a long initial
+    compile before step 1 cannot false-trip it. ``timeout_s <= 0`` disables
+    the watchdog entirely (start/beat/stop become no-ops)."""
+
+    def __init__(self, timeout_s: float, *, name: str = "train",
+                 coordinator: Optional[Coordinator] = None,
+                 poll_s: Optional[float] = None,
+                 abort: Optional[Callable[[str], None]] = None):
+        self.timeout_s = float(timeout_s)
+        self.name = name
+        self._coordinator = coordinator
+        self._poll_s = poll_s if poll_s is not None else max(0.05, self.timeout_s / 4)
+        self._abort = abort
+        self._last_beat: Optional[float] = None
+        self._last_step: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self.timeout_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"hang-watchdog:{self.name}")
+        self._thread.start()
+        log.info("collective-hang watchdog armed: %.1fs heartbeat timeout",
+                 self.timeout_s)
+
+    def beat(self, step: Optional[int] = None) -> None:
+        if self.timeout_s <= 0:
+            return
+        self._last_beat = time.monotonic()
+        self._last_step = step
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self._poll_s)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            last = self._last_beat
+            if last is None:            # not armed until the first beat
+                continue
+            stale = time.monotonic() - last
+            if stale > self.timeout_s:
+                detail = (f"no step-boundary heartbeat for {stale:.1f}s "
+                          f"(timeout {self.timeout_s:.1f}s, last step "
+                          f"{self._last_step})")
+                if self._abort is not None:
+                    self._abort(detail)
+                    return
+                hang_abort(self.name, coordinator=self._coordinator,
+                           detail=detail)
+                return
+
+
+def simulate_hang(reason: str) -> None:
+    """Fault-injection target for the ``hang`` kind: wedge this thread
+    forever, exactly like a host stuck in a dead collective. Only the
+    watchdog (or the scheduler) ends the process."""
+    log_event("injected_hang", reason=reason)
+    while True:                              # pragma: no cover - exited via watchdog
+        time.sleep(3600)

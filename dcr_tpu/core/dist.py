@@ -6,19 +6,165 @@ tcp/env/SLURM rendezvous + mp.spawn): ``jax.distributed.initialize()`` joins hos
 over DCN, XLA owns the chips, and "rank 0" becomes ``jax.process_index() == 0`` for
 I/O only. There is no per-GPU process spawn and no DataParallel fallback — a single
 Mesh covers 1..N chips uniformly (SURVEY.md §5.8).
+
+Resilience hardening (ISSUE 2): the rendezvous retries with backoff (a pod
+restart races its hosts against each other — the first ones up must outwait
+the stragglers), a post-join health check fails fast on an incoherent
+topology instead of hanging in the first collective, and every barrier can
+carry a timeout that raises a typed :class:`BarrierTimeout` instead of
+stalling forever — the primitive the collective-hang watchdog
+(core/coordination.py) is built on.
+
+The control plane deliberately rides the **coordination-service KV store**
+(:func:`kv_client`, pure gRPC with native deadlines) rather than XLA
+collectives: it works before the first computation, keeps working while a
+device collective is wedged (the exact moment the resilience layer must
+act), and works on backends whose compiler has no cross-process support at
+all — this environment's CPU PJRT backend refuses multi-process programs
+outright ('Multiprocess computations aren't implemented on the CPU
+backend', see :func:`xla_multiprocess_supported`).
 """
 
 from __future__ import annotations
 
 import logging
 import os
-from typing import Optional
+import threading
+from typing import Any, Callable, Optional
 
 import jax
 
 log = logging.getLogger("dcr_tpu")
 
 _initialized = False
+
+# int32 gRPC deadline ceiling (~24.8 days) — the "wait forever" encoding for
+# timeout_s <= 0 on coordination-service calls
+_MAX_TIMEOUT_MS = 2 ** 31 - 1
+
+
+class BarrierTimeout(TimeoutError):
+    """A cross-host sync point did not complete within its budget."""
+
+
+class RendezvousError(RuntimeError):
+    """The distributed job came up with an incoherent topology."""
+
+
+def kv_client():
+    """The coordination-service client (KV store + named barriers), present
+    whenever ``jax.distributed.initialize`` has run; None on single-host."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax internals moved
+        return None
+
+
+def xla_multiprocess_supported() -> bool:
+    """Whether the XLA backend can COMPILE computations spanning processes.
+
+    The CPU PJRT backend cannot ('Multiprocess computations aren't
+    implemented on the CPU backend') — the rendezvous, KV store, barriers and
+    fault agreement all still work there, so multi-process CPU jobs run the
+    full control plane for real while each host computes on a local mesh
+    (the Trainer's lockstep-replica mode, used by the 2-process resilience
+    tests)."""
+    return jax.default_backend() != "cpu"
+
+
+def _timeout_ms(timeout_s: float) -> int:
+    return int(timeout_s * 1000) if timeout_s > 0 else _MAX_TIMEOUT_MS
+
+
+def _is_deadline(e: BaseException) -> bool:
+    msg = str(e)
+    return "DEADLINE_EXCEEDED" in msg or "timed out" in msg
+
+
+_seq_lock = threading.Lock()
+_seq_counters: dict[str, int] = {}
+
+
+def _next_seq(tag: str) -> int:
+    """Process-local monotonic sequence per tag. Control-plane operations are
+    collectively ordered program points, so the sequences line up across
+    hosts without any extra synchronization."""
+    with _seq_lock:
+        _seq_counters[tag] = _seq_counters.get(tag, 0) + 1
+        return _seq_counters[tag]
+
+
+def kv_allgather(payload: str, tag: str, timeout_s: float = 0.0) -> list[str]:
+    """Control-plane allgather: publish ``payload`` under (tag, seq, rank) in
+    the coordination-service KV store and blocking-read every peer's slot
+    (rank order). Native per-read deadlines — an absent peer raises
+    :class:`BarrierTimeout` instead of hanging. Each host deletes its own
+    key from round seq-2 on round seq: a peer can only publish round seq-1
+    after fully reading round seq-2, so nothing live is ever deleted."""
+    client = kv_client()
+    if client is None:
+        raise RuntimeError("kv_allgather requires jax.distributed to be "
+                           "initialized (no coordination service client)")
+    rank, count = jax.process_index(), jax.process_count()
+    seq = _next_seq(f"ag:{tag}")
+    base = f"dcr:ag:{tag}"
+    client.key_value_set(f"{base}:{seq}:{rank}", payload)
+    out: list[str] = []
+    for peer in range(count):
+        if peer == rank:
+            out.append(payload)
+            continue
+        try:
+            out.append(client.blocking_key_value_get(
+                f"{base}:{seq}:{peer}", _timeout_ms(timeout_s)))
+        except Exception as e:
+            if _is_deadline(e):
+                raise BarrierTimeout(
+                    f"allgather:{tag}: peer {peer} absent after "
+                    f"{timeout_s:.1f}s — likely hung or dead") from e
+            raise
+    if seq > 2:
+        try:
+            client.key_value_delete(f"{base}:{seq - 2}:{rank}")
+        except Exception:  # cleanup only; the run must not die over it
+            pass
+    return out
+
+
+def run_with_timeout(fn: Callable[[], Any], timeout_s: float, *,
+                     name: str = "collective") -> Any:
+    """Run a (potentially hanging) collective with a wall-clock budget.
+
+    ``timeout_s <= 0`` calls ``fn`` inline (no budget, no extra thread).
+    Otherwise ``fn`` runs in a daemon worker thread and an overrun raises
+    :class:`BarrierTimeout` — the worker itself cannot be cancelled (it is
+    stuck in native code by definition), but the caller regains control to
+    dump diagnostics and abort with a distinct exit code instead of hanging
+    until a scheduler kills the job.
+    """
+    if timeout_s <= 0:
+        return fn()
+    result: list[Any] = []
+    error: list[BaseException] = []
+
+    def target() -> None:
+        try:
+            result.append(fn())
+        except BaseException as e:  # surfaced to the caller below
+            error.append(e)
+
+    t = threading.Thread(target=target, daemon=True, name=f"timeout:{name}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise BarrierTimeout(
+            f"{name}: no completion within {timeout_s:.1f}s — a peer host is "
+            "likely hung or dead")
+    if error:
+        raise error[0]
+    return result[0]
 
 
 def initialize(coordinator_address: Optional[str] = None,
@@ -28,6 +174,14 @@ def initialize(coordinator_address: Optional[str] = None,
 
     Env-driven (TPU pods set everything automatically; explicit args or
     COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID cover manual CPU tests).
+
+    The join itself retries with jittered backoff (DCR_RENDEZVOUS_ATTEMPTS,
+    default 3): on preemptible pods the replacement hosts race each other to
+    the coordinator and the early ones see transient connection errors. After
+    joining, a post-join health check allgathers (process_index,
+    local_device_count) and fails fast with :class:`RendezvousError` on an
+    incoherent topology — a mis-joined pod otherwise dies much later, inside
+    an opaque collective.
     """
     global _initialized
     if _initialized:
@@ -38,14 +192,63 @@ def initialize(coordinator_address: Optional[str] = None,
     if process_id is None and "PROCESS_ID" in os.environ:
         process_id = int(os.environ["PROCESS_ID"])
     if coordinator_address or num_processes:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+        from dcr_tpu.core import resilience as R
+
+        def join() -> None:
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            except Exception:
+                # a half-joined client cannot re-initialize; tear it down so
+                # the retry starts from a clean slate
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
+
+        attempts = int(os.environ.get("DCR_RENDEZVOUS_ATTEMPTS", "3"))
+        R.retry_call(join, attempts=attempts, base_delay=0.5, max_delay=10.0,
+                     retry_on=(RuntimeError, OSError, ValueError),
+                     name="rendezvous")
         log.info("joined distributed job: process %d/%d",
                  jax.process_index(), jax.process_count())
+        _post_join_health_check()
     _initialized = True
+
+
+def _post_join_health_check() -> None:
+    """Fail fast on an incoherent topology right after the join, while the
+    error is still attributable to the rendezvous (device count mismatches,
+    duplicate/missing ranks). Pure control plane (KV allgather, no XLA) with
+    a deadline: a peer that joined but wedged before publishing becomes a
+    RendezvousError here, not a silent infinite hang later."""
+    if jax.process_count() == 1:
+        return
+    timeout_s = float(os.environ.get("DCR_RENDEZVOUS_HEALTH_TIMEOUT_S", "300"))
+    payload = f"{jax.process_index()}:{jax.local_device_count()}"
+    try:
+        rows = kv_allgather(payload, "rendezvous_health", timeout_s)
+    except BarrierTimeout as e:
+        raise RendezvousError(
+            f"post-join health check stalled: {e} (a peer joined the "
+            "rendezvous but never published its topology)") from e
+    parsed = [tuple(int(x) for x in row.split(":")) for row in rows]
+    ranks = [r for r, _ in parsed]
+    if ranks != list(range(jax.process_count())):
+        raise RendezvousError(
+            f"process indices are not 0..{jax.process_count() - 1} in slot "
+            f"order: {ranks} (duplicate or missing rank in the rendezvous)")
+    total = sum(n for _, n in parsed)
+    if total != jax.device_count():
+        raise RendezvousError(
+            f"global device count {jax.device_count()} != sum of per-host "
+            f"local device counts {total} ({parsed})")
+    log.info("rendezvous health check ok: %d processes, %d devices",
+             jax.process_count(), jax.device_count())
 
 
 def is_primary() -> bool:
@@ -61,13 +264,32 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def barrier(name: str = "barrier") -> None:
+def barrier(name: str = "barrier", timeout_s: float = 0.0) -> None:
     """Cross-host sync point (reference uses dist.barrier, diff_retrieval.py:246).
 
-    Implemented as a tiny psum over all devices — cheap, and works on any backend.
+    Rides the coordination service's named barrier — pure gRPC, so it works
+    on every backend and keeps working while device collectives are wedged.
+    ``timeout_s > 0`` bounds the wait and raises :class:`BarrierTimeout`
+    instead of hanging when a peer never arrives (0 = wait forever, the
+    historical behavior). Falls back to a psum-style sync_global_devices when
+    no coordination service exists (cannot happen on a real multi-process
+    job, which requires jax.distributed).
     """
     if jax.process_count() == 1:
         return
+    client = kv_client()
+    if client is not None:
+        seq = _next_seq(f"bar:{name}")
+        try:
+            client.wait_at_barrier(f"dcr:{name}:{seq}", _timeout_ms(timeout_s))
+        except Exception as e:
+            if _is_deadline(e):
+                raise BarrierTimeout(
+                    f"barrier:{name}: peers missing after {timeout_s:.1f}s "
+                    f"({e})") from e
+            raise
+        return
     from jax.experimental import multihost_utils
 
-    multihost_utils.sync_global_devices(name)
+    run_with_timeout(lambda: multihost_utils.sync_global_devices(name),
+                     timeout_s, name=f"barrier:{name}")

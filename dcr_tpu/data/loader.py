@@ -55,7 +55,8 @@ class DataLoader:
                  process_index: int = 0, process_count: int = 1,
                  drop_last: bool = True, prefetch: int = 4,
                  fault: Optional[FaultToleranceConfig] = None,
-                 quarantine: Optional[R.QuarantineManifest] = None):
+                 quarantine: Optional[R.QuarantineManifest] = None,
+                 defer_budget_abort: bool = False):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.dataset = dataset
@@ -73,6 +74,13 @@ class DataLoader:
         self.quarantine = quarantine
         self.bad_samples = 0  # run-total, surfaced as faults/bad_samples
         self._bad_lock = threading.Lock()
+        self._epoch_bad = [0]  # rebound per epoch(); read via epoch_bad_count
+        # multi-host: a loader worker must NOT raise TooManyBadSamples
+        # unilaterally — the budget is pod-global, and one host unwinding
+        # while peers enter the next agreement round hangs the pod. The
+        # trainer sets this on sliced multi-host loaders and aborts through
+        # the fault-agreement word instead (bounded by one log window).
+        self.defer_budget_abort = defer_budget_abort
         if len(dataset) < self.global_batch_size and drop_last:
             raise ValueError(
                 f"dataset of {len(dataset)} samples can't fill one global batch "
@@ -80,6 +88,21 @@ class DataLoader:
 
     def steps_per_epoch(self) -> int:
         return len(self.dataset) // self.global_batch_size
+
+    @property
+    def epoch_bad_count(self) -> int:
+        """Bad samples quarantined by THIS process in the current epoch —
+        the local contribution to the pod-global budget agreement
+        (core/coordination.py)."""
+        return self._epoch_bad[0]
+
+    def epoch_bad_budget(self) -> int:
+        """The epoch's quarantine budget in samples, over the GLOBAL epoch
+        (multi-host: hosts compare the summed count against this at agreement
+        boundaries — per-host counts can each look fine while the pod as a
+        whole is past the line)."""
+        budget_frac = self.fault.max_bad_sample_frac if self.fault else 0.0
+        return int(budget_frac * self.steps_per_epoch() * self.global_batch_size)
 
     def epoch(self, epoch: int, start_step: int = 0) -> Iterator[Batch]:
         """Yield this process's local batches for one epoch.
@@ -99,10 +122,10 @@ class DataLoader:
         out_q: "queue.Queue[tuple[int, Optional[Batch], Optional[BaseException]]]" = (
             queue.Queue(maxsize=self.prefetch))
         stop = threading.Event()
-        epoch_samples = steps * self.global_batch_size
         budget_frac = self.fault.max_bad_sample_frac if self.fault else 0.0
-        epoch_budget = int(budget_frac * epoch_samples)
+        epoch_budget = self.epoch_bad_budget()
         epoch_bad = [0]  # shared across workers, guarded by _bad_lock
+        self._epoch_bad = epoch_bad  # published for the global-budget agreement
 
         def fetch(step: int, slot: int):
             from dcr_tpu.utils import faults
@@ -200,7 +223,7 @@ class DataLoader:
             epoch_bad[0] += 1
             self.bad_samples += 1
             n_bad = epoch_bad[0]
-        if n_bad > epoch_budget:
+        if n_bad > epoch_budget and not self.defer_budget_abort:
             raise TooManyBadSamples(
                 f"epoch {epoch}: {n_bad} bad samples exceed the quarantine "
                 f"budget of {epoch_budget} (max_bad_sample_frac={budget_frac} "

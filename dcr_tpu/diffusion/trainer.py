@@ -10,7 +10,9 @@ and multi-host awareness (one process per host, GSPMD over the mesh).
 from __future__ import annotations
 
 import logging
+import os
 import time
+import zlib
 from pathlib import Path
 from typing import Callable, Optional
 
@@ -18,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcr_tpu.core import coordination as C
 from dcr_tpu.core import dist
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core.checkpoint import CheckpointManager, export_hf_layout
@@ -45,6 +48,23 @@ def _params_finite(tree) -> jax.Array:
     leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree.leaves(tree)
               if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
     return jnp.all(jnp.stack(leaves)) if leaves else jnp.asarray(True)
+
+
+def state_fingerprint(state: "T.TrainState") -> str:
+    """crc32 over this host's view of (unet params, step): a cheap cross-host
+    divergence probe. Logged at end-of-run on multi-host jobs — where params
+    are replicated, equal fingerprints on every rank prove the replicas
+    stayed bit-identical through whatever recovery actions the run took
+    (FSDP-sharded leaves hash only the local shards, so those fingerprints
+    are per-host by construction). Uses the checkpoint layer's host view so
+    non-addressable sharded arrays never hit a raising device_get."""
+    from dcr_tpu.core.checkpoint import _host_view
+
+    crc = 0
+    for leaf in jax.tree.leaves({"unet": state.unet_params, "step": state.step}):
+        arr, _, _ = _host_view(leaf)
+        crc = zlib.crc32(np.ascontiguousarray(arr).tobytes(), crc)
+    return f"{crc:08x}"
 
 
 def build_modules(cfg: TrainConfig, mesh=None) -> "T.DiffusionModels":
@@ -110,7 +130,21 @@ class Trainer:
         # left untouched); the serialized config.json records the effective lr
         cfg = T.resolve_scale_lr(cfg)
         self.cfg = cfg
-        self.mesh = pmesh.make_mesh(cfg.mesh)
+        # lockstep-replica mode: on backends whose compiler cannot span
+        # processes (CPU PJRT — this environment's 2-process resilience
+        # tests), every host computes the SAME global batch on a LOCAL mesh,
+        # so replicas stay bit-identical with no cross-process XLA at all,
+        # while the control plane (rendezvous, agreement, barriers,
+        # checkpoint commits) runs for real over the coordination service.
+        self.replica_mode = (jax.process_count() > 1
+                             and not dist.xla_multiprocess_supported())
+        if self.replica_mode:
+            log.warning(
+                "backend %r cannot compile cross-process XLA: running as "
+                "lockstep replicas (local mesh per host, identical data, "
+                "coordination-service control plane)", jax.default_backend())
+        self.mesh = pmesh.make_mesh(
+            cfg.mesh, devices=jax.local_devices() if self.replica_mode else None)
         self.out_dir = Path(cfg.output_dir)
         if dist.is_primary():
             self.out_dir.mkdir(parents=True, exist_ok=True)
@@ -139,13 +173,21 @@ class Trainer:
         self.dataset = dataset or ObjectAttributeDataset(
             cfg.data, self.tokenizer, fault=cfg.fault)
         # train_batch_size is per-device (reference semantics: per-GPU batch ×
-        # num_processes, diff_train.py:556); each process loads for its local chips
+        # num_processes, diff_train.py:556); each process loads for its local
+        # chips. Replica mode: no slicing — every host loads the identical
+        # full plan, which is what keeps the replicas bit-identical.
         local_bs = cfg.train_batch_size * jax.local_device_count()
         self.loader = DataLoader(
             self.dataset, batch_size=local_bs,
             num_workers=cfg.data.num_workers, seed=cfg.data.seed,
-            process_index=dist.process_index(), process_count=dist.process_count(),
-            fault=cfg.fault, quarantine=self.quarantine)
+            process_index=0 if self.replica_mode else dist.process_index(),
+            process_count=1 if self.replica_mode else dist.process_count(),
+            fault=cfg.fault, quarantine=self.quarantine,
+            # sliced multi-host loaders must abort via the pod agreement, not
+            # a unilateral worker raise (replica mode raises symmetrically —
+            # identical plans — so its local abort stays safe)
+            defer_budget_abort=(dist.process_count() > 1
+                                and not self.replica_mode))
         root = rngmod.root_key(cfg.seed)
         self.models, params = build_models(cfg, rngmod.stream_key(root, "init"),
                                            mesh=self.mesh)
@@ -162,16 +204,34 @@ class Trainer:
                                    use_wandb=cfg.use_wandb,
                                    wandb_project="diffrep_ft",
                                    run_name=run_name(cfg))
+        # -- distributed resilience coordinator (core/coordination.py) -------
+        # every recovery decision below (NaN rollback, preemption stop,
+        # bad-sample abort, fallback-restore choice) goes through a pod-wide
+        # agreement so all hosts act identically at identical steps; on one
+        # host the agreement degenerates to pure local logic (no collectives)
+        hang_timeout = float(os.environ.get("DCR_HANG_TIMEOUT_S",
+                                            cfg.fault.hang_timeout_s) or 0.0)
+        coord_timeout = hang_timeout if hang_timeout > 0 else cfg.fault.barrier_timeout_s
+        self.coord = C.Coordinator(timeout_s=coord_timeout,
+                                   abort_on_timeout=hang_timeout > 0)
+        self.coord.bad_sample_budget = (
+            self.loader.epoch_bad_budget()
+            if cfg.fault.max_bad_sample_frac > 0 else None)
+        self.watchdog = C.HangWatchdog(hang_timeout, coordinator=self.coord)
         self.ckpt = CheckpointManager(self.out_dir / "checkpoints",
                                       max_to_keep=cfg.checkpoints_total_limit,
                                       verify=cfg.fault.verify_checkpoints,
-                                      quarantine=self.quarantine)
+                                      quarantine=self.quarantine,
+                                      coordinator=self.coord)
         self.sample_hook = sample_hook
         # recovery counters, surfaced through MetricWriter at every log
         # boundary (faults/bad_samples rides self.loader.bad_samples)
         self._rollbacks = 0
         self._ckpt_fallbacks = 0
         self._nan_pending = False
+        # set when a coordinated preemption wrote the final checkpoint; the
+        # CLI turns it into coordination.EXIT_PREEMPTED for restart wrappers
+        self.preempted_exit = False
 
     def _publish_tokenizer(self) -> None:
         """Copy BPE vocab/merges into <output_dir>/tokenizer so every
@@ -205,7 +265,19 @@ class Trainer:
         self.ckpt.save(int(jax.device_get(self.state.step)), self.state, force=force)
 
     def maybe_resume(self) -> int:
-        if self.ckpt.latest_step() is None:
+        latest = self.ckpt.latest_step()
+        if jax.process_count() > 1:
+            # entry into the coordinated restore must be SYMMETRIC: agree on
+            # whether anyone sees a checkpoint before any host branches. A
+            # host that sees none while a peer sees step N falls through into
+            # the restore agreement, which fails fast on every host with the
+            # per-rank proposals — instead of the two hosts deadlocking in
+            # different collectives.
+            views = self.coord.agree_int(-1 if latest is None else int(latest),
+                                         "resume_latest")
+            if max(views) < 0:
+                return 0  # genuinely fresh run on every host
+        elif latest is None:
             return 0
         # walk back to the newest VALID checkpoint: a torn/corrupt latest
         # step is quarantined (logged + recorded) and the previous one is
@@ -221,12 +293,25 @@ class Trainer:
         log.info("resumed from checkpoint step %d", step)
         return step
 
+    def _rollback_possible(self) -> bool:
+        """Cheap pre-agreement eligibility check, mirroring the guards at the
+        top of :meth:`_rollback_after_nan`. Shared-filesystem checkpoints and
+        a deterministic rollback counter make the answer identical on every
+        host, so one NaN-seeing host can answer for the pod."""
+        if self._rollbacks >= self.cfg.fault.max_rollbacks:
+            return False
+        self.ckpt.wait()
+        return self.ckpt.latest_step() is not None
+
     def _rollback_after_nan(self, step: int, loss: float) -> bool:
         """NaN rollback-and-skip (opt-in via fault.max_rollbacks): restore the
         last good checkpoint, keep the data pointer at ``step`` so the window
         that produced the non-finite loss is fast-forwarded past, and continue.
         Returns False when rollback is disabled, exhausted, or impossible
         (no checkpoint yet) — the caller then fails fast exactly as the seed.
+        Multi-host: callers reach here only under an agreed ROLLBACK decision,
+        and the restore itself goes through the coordinated
+        ``restore_latest_valid`` (all hosts restore the same step).
         """
         ft = self.cfg.fault
         if self._rollbacks >= ft.max_rollbacks:
@@ -313,9 +398,12 @@ class Trainer:
         The first signal sets the flag and restores the default disposition, so
         a second Ctrl-C/TERM aborts immediately (e.g. while stuck in a long
         compile before any step boundary). Handlers are uninstalled when
-        train() exits. Multi-host: the flag is agreed across processes at the
-        periodic sync point before anyone branches, so one host's signal can't
-        desynchronize the pod's collectives."""
+        train() exits. Multi-host: the flag propagates through the
+        fault-agreement word (core/coordination.py) at the periodic sync
+        point before anyone branches, so one host's signal can't
+        desynchronize the pod's collectives — the pod writes ONE synchronized
+        final checkpoint and every rank exits with
+        ``coordination.EXIT_PREEMPTED``."""
         import signal as _signal
 
         self._preempted = False
@@ -338,23 +426,36 @@ class Trainer:
             _signal.signal(sig, _signal.SIG_DFL)
         self._preempt_signals = ()
 
-    def _global_preempted(self) -> bool:
-        """Pod-wide agreement on the preemption flag: any host signaled →
-        every host stops at the same step (a tiny DCN allgather; called at
-        checkpoint/log boundaries, not every step)."""
-        if jax.process_count() == 1:
-            return getattr(self, "_preempted", False)
-        from jax.experimental import multihost_utils
-
-        flags = multihost_utils.process_allgather(
-            np.asarray([getattr(self, "_preempted", False)]))
-        return bool(np.any(flags))
-
     # -- the loop ------------------------------------------------------------
 
+    def _global_bad_count(self) -> int:
+        """This host's contribution to the pod-global bad-sample agreement.
+        Replica mode: every host quarantines the IDENTICAL samples (same
+        data plan), so only the primary contributes — summing all replicas
+        would double-count each bad sample once per host."""
+        if self.replica_mode and not dist.is_primary():
+            return 0
+        return self.loader.epoch_bad_count
+
     def train(self) -> dict:
+        try:
+            return self._train_impl()
+        finally:
+            # watchdog must die with the loop on EVERY exit path: a fail-fast
+            # exception (FloatingPointError, TooManyBadSamples, loader errors)
+            # stops the heartbeats, and a still-armed watchdog would then
+            # os._exit(EXIT_HANG) mid-unwind, masking the real failure
+            self.watchdog.stop()
+
+    def _train_impl(self) -> dict:
         cfg = self.cfg
         start_step = self.maybe_resume()
+        if jax.process_count() > 1:
+            # startup health check: divergent resume steps (one host restored
+            # a checkpoint a peer can't see) would desynchronize every
+            # collective that follows — fail fast with the per-rank values
+            self.coord.assert_same("resume_step", start_step)
+        self.watchdog.start()
         steps_per_epoch = self.loader.steps_per_epoch()
         # All periodic cadences (log_every / save_steps / modelsavesteps /
         # max_train_steps) count SYNC steps — completed optimizer updates —
@@ -374,7 +475,11 @@ class Trainer:
         step = start_step
         t_last, imgs_last = time.time(), 0
         last_metrics: dict = {}
-        global_bs = cfg.train_batch_size * jax.device_count()
+        # replica mode: every host computes the same batch, so the effective
+        # global batch is one replica's (counting all replicas would double-
+        # count identical samples in the throughput telemetry)
+        global_bs = cfg.train_batch_size * (
+            jax.local_device_count() if self.replica_mode else jax.device_count())
         flops_per_step: float | None = None  # filled after first compiled step
         log.info("training: %d optimizer steps (micro-batch accum %d, "
                  "%d micro/epoch), global batch %d",
@@ -386,41 +491,68 @@ class Trainer:
                 self.state, metrics = self.step_fn(self.state, sharded, self.train_key)
                 step += 1
                 imgs_last += global_bs
+                self.watchdog.beat(step)
                 # deterministic fault-injection hooks (zero-cost when
                 # DCR_FAULTS is unset): nan_loss poisons the next observed
-                # loss; sigterm drives the real preemption path
+                # loss; sigterm drives the real preemption path; hang wedges
+                # this host to drive the collective-hang watchdog; all accept
+                # an @rank= coordinate for single-host faults on a pod
                 if faults.fire("nan_loss", step=step):
                     self._nan_pending = True
                 if faults.fire("sigterm", step=step):
-                    import os
                     import signal as _signal
 
                     os.kill(os.getpid(), _signal.SIGTERM)
+                if faults.fire("hang", step=step):
+                    C.simulate_hang(f"injected hang at step {step}")
                 at_sync = step % accum == 0
                 sync = step // accum
                 if flops_per_step is None:
                     flops_per_step = self._step_flops(sharded)
+                decision: Optional[C.Decision] = None
                 if (at_sync and sync % cfg.log_every == 0) or step == max_micro:
                     metrics = jax.device_get(metrics)
                     if self._nan_pending:
                         metrics["loss"] = float("nan")
                         self._nan_pending = False
-                    if not np.isfinite(metrics["loss"]):
-                        if self._rollback_after_nan(step, float(metrics["loss"])):
-                            # params restored, data pointer kept at `step` —
-                            # the offending window is skipped; continue
+                    # ONE agreement round per boundary carries the whole fault
+                    # word (nan + preempt + bad samples). On a pod EVERY host
+                    # exchanges here even with a locally-finite loss — a
+                    # single rank's NaN must move the whole pod in lockstep,
+                    # and an un-entered collective is itself a hang. One host:
+                    # the exchange is pure local logic, entered only when a
+                    # local flag is set.
+                    nan_here = not np.isfinite(metrics["loss"])
+                    if (nan_here or getattr(self, "_preempted", False)
+                            or jax.process_count() > 1):
+                        if nan_here:
+                            self.coord.note_nan(
+                                step, rollback_ok=self._rollback_possible())
+                        if getattr(self, "_preempted", False):
+                            self.coord.note_preempt()
+                        self.coord.note_bad_samples(self._global_bad_count())
+                        decision = self.coord.exchange(step, tag="sync")
+                        if decision.action is C.Action.ROLLBACK and \
+                                self._rollback_after_nan(
+                                    decision.nan_step, float(metrics["loss"])):
+                            # params restored, data pointer kept at the agreed
+                            # step — the offending window is skipped; continue
                             t_last, imgs_last = time.time(), 0
                             continue
-                        # fail fast instead of training on garbage (the
-                        # reference has no such guard, SURVEY §5.2). Do NOT
-                        # save: params already absorbed the non-finite update —
-                        # the last periodic checkpoint is the recovery point.
-                        self.ckpt.wait()  # flush pending async writes
-                        raise FloatingPointError(
-                            f"non-finite loss {metrics['loss']} at step {step}; "
-                            f"resume from the last good checkpoint "
-                            f"(step {self.ckpt.latest_step()}) under "
-                            f"{self.out_dir}/checkpoints")
+                        if decision.action in (C.Action.ROLLBACK, C.Action.FAIL):
+                            # fail fast instead of training on garbage (the
+                            # reference has no such guard, SURVEY §5.2). Do NOT
+                            # save: params already absorbed the non-finite
+                            # update — the last periodic checkpoint is the
+                            # recovery point. All hosts raise together (same
+                            # decision), so no peer is left in a collective.
+                            self.ckpt.wait()  # flush pending async writes
+                            raise FloatingPointError(
+                                f"non-finite loss {metrics['loss']} at step "
+                                f"{decision.nan_step} (ranks {list(decision.nan_ranks)}); "
+                                f"resume from the last good checkpoint "
+                                f"(step {self.ckpt.latest_step()}) under "
+                                f"{self.out_dir}/checkpoints")
                     dt = time.time() - t_last
                     metrics["images_per_sec"] = imgs_last / max(dt, 1e-9)
                     if flops_per_step:
@@ -443,29 +575,54 @@ class Trainer:
                     t_last, imgs_last = time.time(), 0
                 if self.sample_hook and at_sync and sync % cfg.save_steps == 0:
                     self.sample_hook(self, sync)
-                # preemption check BEFORE the periodic save so the same step is
-                # never written twice inside the shutdown grace window.
-                # Multi-host: the agreement collective must run on EVERY host or
-                # none, so it happens only at the uniform log_every boundary
-                # (a local flag alone must not start a collective).
-                if jax.process_count() > 1:
-                    check_preempt = at_sync and sync % cfg.log_every == 0
-                else:
-                    check_preempt = getattr(self, "_preempted", False)
-                if check_preempt and self._global_preempted():
-                    log.warning("preemption: checkpointing at step %d and "
-                                "stopping (resume picks up here)", step)
-                    self.save(force=True)
-                    self.ckpt.wait()
-                    self.writer.close()
-                    self._uninstall_preemption_handler()
-                    return last_metrics
+                # single-host preemption BETWEEN log boundaries keeps the
+                # seed's act-at-the-very-next-step behavior (pure local
+                # "exchange", no collectives). Multi-host never enters this:
+                # its agreement ran at the uniform log boundary above — a
+                # local flag alone must not start a collective.
+                if (decision is None and jax.process_count() == 1
+                        and getattr(self, "_preempted", False)):
+                    self.coord.note_preempt()
+                    self.coord.note_bad_samples(self._global_bad_count())
+                    decision = self.coord.exchange(step, tag="sync")
+                # act on the agreed decision BEFORE the periodic save so the
+                # same step is never written twice inside the shutdown window
+                if decision is not None:
+                    if decision.action is C.Action.ABORT_BAD_SAMPLES:
+                        from dcr_tpu.data.loader import TooManyBadSamples
+
+                        raise TooManyBadSamples(
+                            f"epoch {epoch}: {decision.bad_total} bad samples "
+                            f"across {jax.process_count()} hosts exceed the "
+                            f"GLOBAL quarantine budget of "
+                            f"{self.coord.bad_sample_budget} "
+                            f"(max_bad_sample_frac="
+                            f"{cfg.fault.max_bad_sample_frac})")
+                    if decision.action is C.Action.CHECKPOINT_AND_EXIT:
+                        log.warning(
+                            "preemption: checkpointing at step %d and "
+                            "stopping (resume picks up here; signaled on "
+                            "ranks %s)", step, list(decision.preempt_ranks))
+                        self.save(force=True)
+                        self.ckpt.wait()
+                        if jax.process_count() > 1:
+                            log.info("state fingerprint at step %d: %s", step,
+                                     state_fingerprint(self.state))
+                        self.writer.close()
+                        self._uninstall_preemption_handler()
+                        self.watchdog.stop()
+                        self.preempted_exit = True
+                        return last_metrics
                 if at_sync and sync % cfg.modelsavesteps == 0:
                     self.save()
                 if step >= max_micro:
                     break
+        self.watchdog.stop()  # export/teardown below has no step heartbeat
         self.save(force=True)
         self.ckpt.wait()
+        if jax.process_count() > 1:
+            log.info("state fingerprint at step %d: %s", step,
+                     state_fingerprint(self.state))
         self.export_checkpoint()
         self.writer.close()
         self._uninstall_preemption_handler()

@@ -230,8 +230,15 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
     log.info("eval: %d query (gen) vs %d values (train)", len(query), len(values))
 
     # every stage below is an auditable [stage] boundary with a soft watchdog
-    # budget (fault.stage_deadline_secs; 0 = just the begin/end log lines)
+    # budget (fault.stage_deadline_secs; 0 = just the begin/end log lines).
+    # Multi-host: each boundary is also a timeout-bounded barrier — hosts do
+    # different amounts of primary-only I/O between collectives, and a peer
+    # that died inside a stage must surface as a typed BarrierTimeout at the
+    # next boundary, not as a silent hang in the next collective.
     stage_deadline = cfg.fault.stage_deadline_secs
+
+    def stage_sync(name: str) -> None:
+        dist.barrier(f"eval:{name}", timeout_s=cfg.fault.barrier_timeout_s)
 
     if backbone_params is None and cfg.weights_path:
         log.info("loading %s backbone weights from %s", cfg.pt_style,
@@ -271,6 +278,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                                                         batch_size=cfg.batch_size))
         values_feats = SIM.l2_normalize(extract_features(values, extractor,
                                                          batch_size=cfg.batch_size))
+    stage_sync("features")
 
     with R.stage("eval/similarity", deadline=stage_deadline):
         sim = SIM.similarity_matrix(values_feats, query_feats,
@@ -288,6 +296,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
         stamp(out_dir)
         np.save(out_dir / "similarity.npy", sim)
         G.histogram_plot(stats.top1, bg, out_dir / "histogram.png")
+    stage_sync("similarity")
 
     if cfg.compute_clip_score:
         with R.stage("eval/clip_score", deadline=stage_deadline):
@@ -308,6 +317,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                 query, tokenizer, mesh, scorer_params=scorer_params)
             scalars["train_clipscore"] = clip_alignment_score(
                 values, tokenizer, mesh, scorer_params=scorer_params)
+        stage_sync("clip_score")
 
     if cfg.compute_complexity:
         # de-duplicated streaming measurement: unique match images are decoded
@@ -327,6 +337,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
                 G.scatter_plot(np.asarray(series["tv"]), stats.top1,
                                "match total variation", "top1 sim",
                                out_dir / "scatter_tv.png")
+        stage_sync("complexity")
 
     if cfg.dup_weights_pickle:
         weights = np.asarray(pickle.loads(R.read_bytes_with_retry(
@@ -379,6 +390,7 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
             scalars.update(IPR.precision_recall(
                 extract_features(v224, vgg_extract, batch_size=cfg.batch_size),
                 extract_features(q224, vgg_extract, batch_size=cfg.batch_size)))
+        stage_sync("fid_ipr")
 
     if cfg.galleries and dist.is_primary():
         with R.stage("eval/galleries", deadline=stage_deadline):

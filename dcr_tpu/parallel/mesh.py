@@ -52,17 +52,27 @@ def data_parallel_size(mesh: Mesh) -> int:
     return mesh.shape[DATA_AXIS] * mesh.shape[FSDP_AXIS]
 
 
+def mesh_spans_processes(mesh: Mesh) -> bool:
+    """True when the mesh's devices live on more than one process. Local
+    meshes on a multi-process job (the lockstep-replica mode backends without
+    cross-process XLA use) must take the single-host placement paths."""
+    return len({d.process_index for d in mesh.devices.flat}) > 1
+
+
 def shard_batch(mesh: Mesh, batch):
     """Place a host-global numpy batch onto the mesh, sharded on the batch axis.
 
-    With multiple processes each host passes its local shard;
-    ``make_array_from_process_local_data`` assembles the global array.
+    When the mesh spans processes each host passes its local shard;
+    ``make_array_from_process_local_data`` assembles the global array. A
+    local mesh (single process, or one replica of a multi-process CPU job)
+    takes the plain device_put path.
     """
     sharding = batch_sharding(mesh)
+    spans = mesh_spans_processes(mesh)
 
     def put(x):
         x = np.asarray(x)
-        if jax.process_count() > 1:
+        if spans:
             return jax.make_array_from_process_local_data(sharding, x)
         return jax.device_put(x, sharding)
 

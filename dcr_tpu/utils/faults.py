@@ -7,7 +7,11 @@ A fault spec is an env/config-driven string of comma-separated entries:
 Each entry is ``kind@key=value[&key=value...][xN]``: the fault ``kind`` fires
 when a hook point reports coordinates matching EVERY ``key=value`` pair in the
 entry (coordinates the entry doesn't name are ignored), at most ``N`` times
-(default 1). Supported kinds and their hook points:
+(default 1). ``@`` also separates coordinate pairs, so multi-host specs read
+naturally: ``nan_loss@step=5@rank=1`` fires only on process index 1 — the
+``rank`` coordinate is implicit at every hook point (filled from
+``jax.process_index()``), which is how single-rank faults drive the
+coordinated-recovery tests. Supported kinds and their hook points:
 
 - ``decode_error`` — DataLoader, per sample; coords ``step``, ``slot``,
   ``index``, ``epoch``. Simulates a corrupt image: raises
@@ -20,6 +24,9 @@ entry (coordinates the entry doesn't name are ignored), at most ``N`` times
   observed loss at a log boundary, driving the rollback-or-fail-fast path.
 - ``sigterm`` — Trainer loop, coord ``step``: delivers a real SIGTERM to the
   process, driving the preemption checkpoint-and-stop path.
+- ``hang`` — Trainer loop, coord ``step``: wedges the host thread forever
+  (a dead peer inside a collective), driving the hang-watchdog abort path
+  (core/coordination.py).
 
 The registry is process-global, parsed once from ``DCR_FAULTS`` (tests use
 :func:`install`/:func:`clear`), thread-safe (loader workers fire
@@ -43,8 +50,18 @@ class InjectedFault(RuntimeError):
     """Raised (or delivered) by an injection hook; never by production code."""
 
 
-_ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<coords>[a-z_]+=\d+(?:&[a-z_]+=\d+)*)"
+_ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<coords>[a-z_]+=\d+(?:[&@][a-z_]+=\d+)*)"
                        r"(?:x(?P<times>\d+))?$")
+
+
+def _current_rank() -> int:
+    """The implicit ``rank`` coordinate for ``@rank=`` targeting."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # jax not importable in some harness contexts
+        return int(os.environ.get("PROCESS_ID", "0") or 0)
 
 
 @dataclass
@@ -71,7 +88,7 @@ def parse_faults(spec: str) -> list[FaultSpec]:
                 f"malformed fault entry {entry!r} "
                 "(expected kind@key=value[&key=value...][xN])")
         where = {k: int(v) for k, v in
-                 (pair.split("=") for pair in m.group("coords").split("&"))}
+                 (pair.split("=") for pair in re.split(r"[&@]", m.group("coords")))}
         out.append(FaultSpec(kind=m.group("kind"), where=where,
                              times=int(m.group("times") or 1)))
     return out
@@ -82,12 +99,23 @@ class FaultRegistry:
     specs: list[FaultSpec] = field(default_factory=list)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
+    def __post_init__(self) -> None:
+        # resolved lazily at fire time (jax may not be initialized yet when
+        # DCR_FAULTS is parsed), but only when some spec targets a rank
+        self._needs_rank = any("rank" in s.where for s in self.specs)
+
     def __bool__(self) -> bool:
         return bool(self.specs)
 
     def fire(self, kind: str, **coords: int) -> bool:
         """True iff a spec matches these coordinates and still has fires left.
-        Firing is atomic: concurrent hook calls can't double-spend a spec."""
+        Firing is atomic: concurrent hook calls can't double-spend a spec.
+        Empty registry: no lock taken — hook points stay contention-free when
+        injection is off (loader workers + the train thread share this)."""
+        if not self.specs:
+            return False
+        if self._needs_rank and "rank" not in coords:
+            coords["rank"] = _current_rank()
         with self._lock:
             for s in self.specs:
                 if s.matches(kind, coords):

@@ -1,0 +1,128 @@
+"""Shared launcher for real two-process ``jax.distributed`` tests.
+
+Used by tests/test_multihost.py (DCN collectives) and
+tests/test_coordination.py (resilience e2e). Centralizes the one genuinely
+flaky part: the rendezvous port. The historical pattern — bind an ephemeral
+port, close it, hand the number to the workers — races every other process
+on the machine for the window between close() and the coordinator's bind;
+under a parallel CI box that's a steady trickle of spurious failures. The
+fix is pragmatic: keep the pick-then-close (jax's coordinator must bind the
+port itself), but RETRY the whole two-process launch on a fresh port when
+the failure output is recognizably a bind/conflict error rather than a real
+test failure.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+
+# signatures of "the coordinator could not bind / a stale peer owns the
+# port" — anything else is a genuine failure and must surface immediately
+BIND_ERROR_MARKERS = (
+    "address already in use",
+    "Address already in use",
+    "Failed to bind",
+    "failed to bind",
+    "errno: 98",
+)
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def looks_like_bind_race(outputs: list[str]) -> bool:
+    return any(marker in out for out in outputs for marker in BIND_ERROR_MARKERS)
+
+
+def run_two_process(argv: list[str], *, env: dict, timeout: int = 240,
+                    attempts: int = 3,
+                    extra_env_per_rank: list[dict] | None = None) -> list[tuple[int, str]]:
+    """Launch ``argv`` twice as ranks 0/1 of a localhost jax.distributed job.
+
+    ``env`` is the complete base environment for both workers; per-rank
+    COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID are injected, plus
+    ``extra_env_per_rank[rank]`` when given. Returns ``[(returncode,
+    combined_output), ...]`` indexed by rank. Retries the whole launch on a
+    fresh port when every-rank output points at a bind race (see module
+    docstring); raises TimeoutError (after killing both) when a worker
+    outlives ``timeout`` — callers asserting watchdog behavior rely on the
+    workers exiting on their own well before that.
+    """
+    last_outputs: list[str] = []
+    for attempt in range(1, attempts + 1):
+        addr = f"127.0.0.1:{free_port()}"
+        procs = []
+        for rank in range(2):
+            worker_env = dict(env)
+            worker_env.update({
+                "COORDINATOR_ADDRESS": addr,
+                "NUM_PROCESSES": "2",
+                "PROCESS_ID": str(rank),
+            })
+            if extra_env_per_rank:
+                worker_env.update(extra_env_per_rank[rank])
+            procs.append(subprocess.Popen(
+                argv, env=worker_env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+        results: list[tuple[int, str]] = []
+        deadline = time.monotonic() + timeout
+        try:
+            for p in procs:
+                remaining = max(1.0, deadline - time.monotonic())
+                out, _ = p.communicate(timeout=remaining)
+                results.append((p.returncode, out))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            tails = []
+            for r, p in enumerate(procs):
+                if r < len(results):  # finished before the timeout
+                    tails.append(f"--- rank {r} (rc {results[r][0]}) tail ---\n"
+                                 f"{results[r][1][-2000:]}")
+                    continue
+                try:  # communicate() closes stdout on ranks it completed
+                    out, _ = p.communicate(timeout=5)
+                except Exception:
+                    out = "<output unavailable>"
+                tails.append(f"--- rank {r} tail ---\n{out[-2000:]}")
+            raise TimeoutError(
+                f"two-process workers exceeded {timeout}s (attempt {attempt}); "
+                f"partial output:\n" + "\n".join(tails))
+        last_outputs = [out for _, out in results]
+        failed = any(rc != 0 for rc, _ in results)
+        if failed and attempt < attempts and looks_like_bind_race(last_outputs):
+            continue  # rendezvous port race: relaunch on a fresh port
+        return results
+    raise AssertionError("unreachable")
+
+
+def worker_base_env(*, local_devices: int = 1, inherit: bool = False) -> dict:
+    """Environment for a two-process worker.
+
+    ``inherit=False`` (collective unit tests): a minimal clean env, so the
+    workers can't pick up the parent pytest's 8-device XLA_FLAGS or fault
+    specs. ``inherit=True`` (CLI e2e tests): start from os.environ — the
+    persistent XLA compile cache and platform pins carry over — then force
+    the device count down to ``local_devices``.
+    """
+    if inherit:
+        env = dict(os.environ)
+        env.pop("DCR_FAULTS", None)
+    else:
+        env = {"PATH": "/usr/bin:/bin:/usr/local/bin", "HOME": "/tmp"}
+    env["PYTHONPATH"] = str(REPO) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
+    return env

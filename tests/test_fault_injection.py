@@ -487,9 +487,12 @@ def test_sigterm_midtrain_resume_is_bit_exact(train_setup):
     proc, out = _run_cli(ref_cfg, base / "ref_cfg.json")
     assert proc.returncode == 0, out[-3000:]
 
-    # interrupted leg: real SIGTERM at micro-step 3; process checkpoints, dies
+    # interrupted leg: real SIGTERM at micro-step 3; process checkpoints and
+    # dies with the distinct preempted code a restart wrapper branches on
+    from dcr_tpu.core.coordination import EXIT_PREEMPTED
+
     proc, out = _run_cli(cfg, base / "cfg.json", dcr_faults="sigterm@step=3")
-    assert proc.returncode == 0, out[-3000:]
+    assert proc.returncode == EXIT_PREEMPTED, (proc.returncode, out[-3000:])
     assert "fault injection ACTIVE" in out       # CLI announced the harness
     assert "preemption: checkpointing at step 3" in out
     assert (base / "run" / "checkpoints" / "3").exists()

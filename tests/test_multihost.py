@@ -3,12 +3,11 @@ jax.distributed, shard a batch across their devices, and verify a global
 reduction + process_allgather (SURVEY.md §4 item 3: 'multi-process DCN paths
 tested with jax.distributed over localhost subprocesses')."""
 
-import socket
-import subprocess
 import sys
-from pathlib import Path
 
 import pytest
+
+from tests._multiproc import run_two_process, worker_base_env
 
 WORKER = r"""
 import sys
@@ -116,38 +115,15 @@ print(f"RANK{dist.process_index()}_SIM_OK")
 
 def _run_two_process(worker_src: str, ok_token: str, *, local_devices: int = 1,
                      timeout: int = 240) -> None:
-    port = socket.socket()
-    port.bind(("127.0.0.1", 0))
-    addr = f"127.0.0.1:{port.getsockname()[1]}"
-    port.close()
-    repo = str(Path(__file__).parent.parent)
-    procs = []
-    for rank in range(2):
-        env = {
-            "COORDINATOR_ADDRESS": addr,
-            "NUM_PROCESSES": "2",
-            "PROCESS_ID": str(rank),
-            "PYTHONPATH": repo,
-            "PATH": "/usr/bin:/bin:/usr/local/bin",
-            "HOME": "/tmp",
-        }
-        if local_devices > 1:
-            env["XLA_FLAGS"] = (
-                f"--xla_force_host_platform_device_count={local_devices}")
-        procs.append(subprocess.Popen([sys.executable, "-c", worker_src],
-                                      env=env, stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True))
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process workers timed out")
-        outs.append(out)
-    for rank, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"rank {rank} failed:\n{out[-2000:]}"
+    # launch (with rendezvous-port-race retry) via the shared helper
+    try:
+        results = run_two_process(
+            [sys.executable, "-c", worker_src],
+            env=worker_base_env(local_devices=local_devices), timeout=timeout)
+    except TimeoutError as e:
+        pytest.fail(f"multi-process workers timed out: {e}")
+    for rank, (rc, out) in enumerate(results):
+        assert rc == 0, f"rank {rank} failed:\n{out[-2000:]}"
         assert ok_token.format(rank=rank) in out
 
 

@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Merge per-process quarantine manifests into one pod-level fault report.
+
+A multi-host run writes one append-only quarantine file per process
+(``quarantine.jsonl`` on rank 0, ``quarantine.p<N>.jsonl`` on the rest) so
+loader workers on every host can record locally without cross-host write
+contention. This tool folds them back into a single picture:
+
+    python tools/merge_quarantine.py <run_dir> [--out report.json]
+                                     [--merged merged.jsonl]
+
+- the REPORT (stdout or --out) carries totals, counts per fault kind, per
+  rank, and per (kind, rank) — the "how unhealthy was this pod run, and was
+  it one sick host or everyone" summary;
+- --merged optionally writes every record from every rank into one
+  time-sorted JSONL (each record gains a "rank" field) for timeline digging.
+
+Exit status is 0 even when faults were recorded (reporting is not judging);
+it is 2 when the run dir has no quarantine files at all, so wrappers can
+distinguish "clean run" from "wrong directory".
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+_RANK_RE = re.compile(r"^quarantine(?:\.p(?P<rank>\d+))?\.jsonl$")
+
+
+def find_manifests(run_dir: Path) -> dict[int, Path]:
+    """{rank: path} for every per-process quarantine file under run_dir."""
+    out: dict[int, Path] = {}
+    for path in sorted(run_dir.glob("quarantine*.jsonl")):
+        m = _RANK_RE.match(path.name)
+        if m is None:
+            continue
+        out[int(m.group("rank") or 0)] = path
+    return out
+
+
+def load_entries(manifests: dict[int, Path]) -> list[dict]:
+    """All records, each stamped with its source rank, time-sorted (stable:
+    ties keep rank order so interleavings are deterministic)."""
+    entries: list[dict] = []
+    for rank in sorted(manifests):
+        for lineno, line in enumerate(
+                manifests[rank].read_text().splitlines(), start=1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(
+                    f"{manifests[rank]}:{lineno}: not valid JSON ({e}) — "
+                    "was the run killed mid-append? inspect the file "
+                    "manually") from e
+            rec["rank"] = rank
+            entries.append(rec)
+    entries.sort(key=lambda r: (r.get("time", 0), r["rank"]))
+    return entries
+
+
+def build_report(run_dir: Path, manifests: dict[int, Path],
+                 entries: list[dict]) -> dict:
+    by_kind: dict[str, int] = {}
+    by_rank: dict[str, int] = {}
+    by_kind_rank: dict[str, int] = {}
+    for rec in entries:
+        kind = rec.get("kind", "unknown")
+        by_kind[kind] = by_kind.get(kind, 0) + 1
+        by_rank[str(rec["rank"])] = by_rank.get(str(rec["rank"]), 0) + 1
+        key = f"{kind}@rank{rec['rank']}"
+        by_kind_rank[key] = by_kind_rank.get(key, 0) + 1
+    return {
+        "run_dir": str(run_dir),
+        "processes": sorted(manifests),
+        "total": len(entries),
+        "by_kind": dict(sorted(by_kind.items())),
+        "by_rank": dict(sorted(by_rank.items(), key=lambda kv: int(kv[0]))),
+        "by_kind_rank": dict(sorted(by_kind_rank.items())),
+        "first_time": entries[0].get("time") if entries else None,
+        "last_time": entries[-1].get("time") if entries else None,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", type=Path,
+                    help="training/eval output dir holding quarantine*.jsonl")
+    ap.add_argument("--out", type=Path, default=None,
+                    help="write the JSON report here instead of stdout")
+    ap.add_argument("--merged", type=Path, default=None,
+                    help="also write all records, rank-stamped and "
+                         "time-sorted, as one JSONL")
+    args = ap.parse_args(argv)
+
+    manifests = find_manifests(args.run_dir)
+    if not manifests:
+        print(f"no quarantine*.jsonl under {args.run_dir}", file=sys.stderr)
+        return 2
+    entries = load_entries(manifests)
+    report = build_report(args.run_dir, manifests, entries)
+    text = json.dumps(report, indent=2, sort_keys=False)
+    if args.out:
+        args.out.write_text(text + "\n")
+    else:
+        print(text)
+    if args.merged:
+        with args.merged.open("w") as f:
+            for rec in entries:
+                f.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
