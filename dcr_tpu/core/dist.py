@@ -128,9 +128,21 @@ def kv_allgather(payload: str, tag: str, timeout_s: float = 0.0) -> list[str]:
     if seq > 2:
         try:
             client.key_value_delete(f"{base}:{seq - 2}:{rank}")
-        except Exception:  # cleanup only; the run must not die over it
-            pass
+        except Exception as e:  # cleanup only; the run must not die over it
+            from dcr_tpu.core import resilience as R
+
+            R.log_event("kv_gc_error", tag=tag, seq=seq - 2, error=repr(e))
+            R.bump_counter("kv_gc_errors")
     return out
+
+
+def default_allgather_timeout_s() -> float:
+    """Wall-clock bound for data-plane allgathers that have no native
+    deadline (``multihost_utils.process_allgather``), used with
+    :func:`run_with_timeout`. Generous default — the point is turning a
+    dead-peer hang into a typed :class:`BarrierTimeout`, not policing slow
+    links; set ``DCR_ALLGATHER_TIMEOUT_S=0`` to wait forever."""
+    return float(os.environ.get("DCR_ALLGATHER_TIMEOUT_S", "600"))
 
 
 def run_with_timeout(fn: Callable[[], Any], timeout_s: float, *,
@@ -206,8 +218,12 @@ def initialize(coordinator_address: Optional[str] = None,
                 # the retry starts from a clean slate
                 try:
                     jax.distributed.shutdown()
-                except Exception:
-                    pass
+                except Exception as te:
+                    # teardown failure must stay visible: if the client is
+                    # still half-alive the next join attempt fails strangely,
+                    # and this line is the only clue why
+                    R.log_event("rendezvous_teardown_error", error=repr(te))
+                    R.bump_counter("rendezvous_teardown_errors")
                 raise
 
         attempts = int(os.environ.get("DCR_RENDEZVOUS_ATTEMPTS", "3"))

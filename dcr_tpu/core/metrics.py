@@ -123,7 +123,15 @@ class SmoothedValue:
             return
         from jax.experimental import multihost_utils
 
-        t = multihost_utils.process_allgather(np.array([self.count, self.total]))
+        from dcr_tpu.core import dist
+
+        # telemetry must never wedge the pod: a peer that died between its
+        # last step and this reduction turns into a diagnosable BarrierTimeout
+        # instead of an eternal hang inside the allgather
+        t = dist.run_with_timeout(
+            lambda: multihost_utils.process_allgather(
+                np.array([self.count, self.total])),
+            dist.default_allgather_timeout_s(), name="meter_sync")
         t = np.sum(t, axis=0)
         self.count, self.total = int(t[0]), float(t[1])
 

@@ -42,6 +42,40 @@ def log_event(event: str, **fields: Any) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Process-wide fault counters
+# ---------------------------------------------------------------------------
+# Shared sink for recovered-from failures that happen below the Trainer
+# (decode fast-path fallbacks, rendezvous teardown errors, ...). The trainer
+# surfaces them through MetricWriter as ``faults/<name>`` at every log
+# boundary, so no swallow is ever invisible on a dashboard. Counters reset
+# with the process; the structured log line each bump pairs with is the
+# durable record.
+
+_counters_lock = threading.Lock()
+_counters: dict[str, int] = {}
+
+
+def bump_counter(name: str, n: int = 1) -> int:
+    """Increment the process-wide ``faults/<name>`` counter; returns the new
+    value. Thread-safe (loader workers bump concurrently)."""
+    with _counters_lock:
+        _counters[name] = _counters.get(name, 0) + n
+        return _counters[name]
+
+
+def counters() -> dict[str, int]:
+    """Snapshot of all process-wide fault counters."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def reset_counters() -> None:
+    """Test hook: start a scenario from zero."""
+    with _counters_lock:
+        _counters.clear()
+
+
+# ---------------------------------------------------------------------------
 # Retry with exponential backoff
 # ---------------------------------------------------------------------------
 

@@ -79,8 +79,14 @@ def _open_image(path: str, size: int) -> Image.Image:
                 arr = jpeg_decoder.decode_scaled(Path(path).read_bytes(), size)
                 if arr is not None:
                     return Image.fromarray(arr)
-        except Exception:
-            pass
+        except Exception as e:
+            # fall back to the full PIL decode below, but never silently: a
+            # systematic fast-path failure (bad libjpeg build, corrupt shard)
+            # must show up in the faults/ telemetry, not as a 10x slowdown
+            from dcr_tpu.core import resilience as R
+
+            R.log_event("jpeg_fast_path_error", path=str(path), error=repr(e))
+            R.bump_counter("jpeg_fast_path_errors")
     with Image.open(path) as img:
         return img.convert("RGB").copy()
 

@@ -376,7 +376,13 @@ class Trainer:
                 },
                 model_config=to_dict(self.cfg.model),
             )
-        dist.barrier("export")
+        # bounded: a peer that died mid-export must become a BarrierTimeout,
+        # not an eternal hang. barrier_timeout_s defaults to 0 (= wait
+        # forever), so fall back to the generous allgather bound; operators
+        # can still opt out globally with DCR_ALLGATHER_TIMEOUT_S=0.
+        dist.barrier("export",
+                     timeout_s=(self.cfg.fault.barrier_timeout_s
+                                or dist.default_allgather_timeout_s()))
         return out
 
     def _step_flops(self, sharded_batch) -> float:
@@ -570,6 +576,10 @@ class Trainer:
                     metrics["faults/bad_samples"] = self.loader.bad_samples
                     metrics["faults/rollbacks"] = self._rollbacks
                     metrics["faults/ckpt_fallbacks"] = self._ckpt_fallbacks
+                    # process-wide counters bumped below the Trainer (decode
+                    # fast-path fallbacks, kv teardown/gc errors, ...)
+                    for name, count in R.counters().items():
+                        metrics[f"faults/{name}"] = count
                     self.writer.scalars(sync, metrics)
                     last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                     t_last, imgs_last = time.time(), 0

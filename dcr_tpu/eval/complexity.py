@@ -42,8 +42,13 @@ def jpeg_size(image: np.ndarray, quality: int = 95) -> int:
         size = jpeg_helper.encoded_size(arr, quality)
         if size is not None:
             return size
-    except Exception:
-        pass
+    except Exception as e:
+        # PIL fallback below keeps the metric correct; log + count so a
+        # broken native encoder is visible instead of a silent eval slowdown
+        from dcr_tpu.core import resilience as R
+
+        R.log_event("jpeg_helper_error", error=repr(e))
+        R.bump_counter("jpeg_helper_errors")
     buf = io.BytesIO()
     Image.fromarray(arr).save(buf, format="JPEG", quality=quality)
     return buf.tell()

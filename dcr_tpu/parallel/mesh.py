@@ -110,7 +110,13 @@ def to_host(x) -> np.ndarray:
         return np.asarray(jax.device_get(x))
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    from dcr_tpu.core import dist
+
+    # bounded: a host that died mid-eval turns this into a BarrierTimeout
+    # with a name, instead of every surviving rank hanging in the gather
+    return np.asarray(dist.run_with_timeout(
+        lambda: multihost_utils.process_allgather(x, tiled=True),
+        dist.default_allgather_timeout_s(), name="to_host"))
 
 
 @contextmanager

@@ -1,0 +1,1 @@
+# Makes `python -m tools.lint` resolvable from the repo root.

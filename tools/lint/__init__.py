@@ -1,0 +1,43 @@
+"""dcr-lint: first-party static analysis for the dcr_tpu training stack.
+
+The paper's replication measurements — and the resilience layer's bit-exact
+rollback/resume and pod-wide fault agreement — only hold if the stack is
+*provably* deterministic and collective-safe. One unsplit RNG key, one host
+sync inside a jitted step, or one rank-conditional collective silently breaks
+bit-exact recovery or hangs a pod hours into a run. dcr-lint enforces those
+invariants mechanically, before any TPU time is spent:
+
+=======  ====================================================================
+DCR001   host-sync / tracer leak inside a jitted function (``.item()``,
+         ``np.*`` on traced values, ``jax.device_get``, casts on traced args)
+DCR002   donation-after-use: an argument named in ``donate_argnums`` is read
+         after the donating call (XLA freed/aliased that buffer)
+DCR003   RNG key reuse: the same key consumed by two sampling calls without
+         an intervening ``split``/``fold_in``
+DCR004   unbounded collective: ``barrier``/``kv_allgather``/allgather calls
+         with no timeout — a dead peer hangs the pod forever
+DCR005   rank-divergent collective: a collective issued under a
+         ``process_index() == 0``-style conditional — the other ranks never
+         enter it and the pod deadlocks
+DCR006   silent exception swallow: ``except Exception: pass`` with no
+         structured log / counter / quarantine on a recovery path
+DCR007   recompilation hazard: Python ``if``/``while`` on a traced argument
+         inside a jitted function without ``static_argnames``
+DCR008   nondeterminism: global ``random.*`` / ``np.random.*`` state, or
+         wall-clock reads traced into a jitted function
+=======  ====================================================================
+
+Usage::
+
+    python -m tools.lint [paths...]            # human output, exit 1 on findings
+    python -m tools.lint --format json ...     # machine-readable report
+    python -m tools.lint --list-rules          # rule table
+    python -m tools.lint --write-baseline ...  # grandfather current findings
+
+Suppression: a per-line ``# dcr-lint: disable=DCR004`` pragma, or an entry in
+``tools/lint/baseline.json`` (every entry must carry a written justification).
+Configuration lives in ``[tool.dcr-lint]`` in pyproject.toml.
+"""
+
+from tools.lint.engine import Finding, LintError, lint_source, scan  # noqa: F401
+from tools.lint.rules import RULES  # noqa: F401

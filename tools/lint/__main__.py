@@ -1,0 +1,99 @@
+"""CLI: ``python -m tools.lint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 configuration/usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.lint.config import load_config
+from tools.lint.engine import LintError, scan, write_baseline
+from tools.lint.rules import RULES
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="dcr-lint: JAX-aware determinism/donation/RNG/collective "
+                    "static analysis for the dcr_tpu stack")
+    p.add_argument("paths", nargs="*", default=["dcr_tpu", "tests", "tools"],
+                   help="files/directories to scan (default: dcr_tpu tests tools)")
+    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (overrides config)")
+    p.add_argument("--ignore", default=None,
+                   help="comma-separated rule ids to drop (overrides config)")
+    p.add_argument("--config", type=Path, default=None,
+                   help="pyproject.toml to read [tool.dcr-lint] from "
+                        "(default: nearest to cwd)")
+    p.add_argument("--baseline", type=Path, default=None,
+                   help="baseline file (default: [tool.dcr-lint].baseline)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report baselined findings too")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write every current finding to the baseline file "
+                        "(you must then fill in each justification)")
+    p.add_argument("--list-rules", action="store_true")
+    return p
+
+
+def _list_rules() -> int:
+    width = max(len(r.title) for r in RULES.values())
+    for rule in RULES.values():
+        print(f"{rule.rule_id}  {rule.title:<{width}}  {rule.summary}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        return _list_rules()
+    try:
+        cfg = load_config(pyproject=args.config)
+        if args.select:
+            cfg.select = tuple(s.strip().upper()
+                               for s in args.select.split(",") if s.strip())
+        if args.ignore:
+            cfg.ignore = tuple(s.strip().upper()
+                               for s in args.ignore.split(",") if s.strip())
+        use_baseline = not (args.no_baseline or args.write_baseline)
+        report = scan(args.paths, cfg, use_baseline=use_baseline,
+                      baseline_override=args.baseline)
+    except LintError as e:
+        print(f"dcr-lint: error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        bl = args.baseline or (cfg.root / (cfg.baseline or
+                                           "tools/lint/baseline.json"))
+        write_baseline(Path(bl), report.findings)
+        print(f"dcr-lint: wrote {len(report.findings)} entr"
+              f"{'y' if len(report.findings) == 1 else 'ies'} to {bl}; "
+              "fill in each justification (the run fails until you do)")
+        return 0
+
+    if args.format == "json":
+        print(json.dumps(report.to_json(), indent=2))
+    else:
+        for f in report.findings:
+            print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+        for entry in report.stale_baseline:
+            print(f"dcr-lint: stale baseline entry (no longer matches): "
+                  f"{entry['rule']} {entry['path']} — remove it",
+                  file=sys.stderr)
+        counts = report.counts()
+        summary = ", ".join(f"{k}×{v}" for k, v in counts.items()) or "clean"
+        print(f"dcr-lint: {len(report.findings)} finding"
+              f"{'' if len(report.findings) == 1 else 's'} "
+              f"({summary}) in {report.files_scanned} files "
+              f"[suppressed: {report.baseline_suppressed} baseline, "
+              f"{report.pragma_suppressed} pragma]")
+    return 1 if report.findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

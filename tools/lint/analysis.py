@@ -1,0 +1,413 @@
+"""Per-module AST analysis shared by every dcr-lint checker.
+
+One pass over the module builds everything the rules need:
+
+- import alias resolution (``np`` -> ``numpy``, ``jr`` -> ``jax.random``,
+  ``from jax import jit`` -> ``jax.jit``) so checkers match on canonical
+  dotted names instead of guessing at surface spellings;
+- the *jit index*: every function that is traced — decorated with
+  ``@jax.jit`` / ``@partial(jax.jit, ...)``, passed to ``jax.jit(f, ...)``
+  (including lambdas and ``jax.jit(jax.grad(f))``), plus its
+  static/donate argument metadata;
+- the *donation index*: local names bound to ``jax.jit(..., donate_argnums=)``
+  results, per scope, so DCR002 can follow donated buffers at call sites;
+- a parent map and scope/branch-aware statement linearization for the
+  order-sensitive rules (donation-after-use, key reuse).
+
+Everything here is heuristic in the way a first-party linter can afford to
+be: module-local, name-based, no type inference. The rules it feeds are
+written so a miss is possible but a hit is near-certainly real.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+# canonical dotted names that mean "this function is traced"
+JIT_WRAPPERS = {
+    "jax.jit", "jax.pjit",
+    "jax.experimental.pjit.pjit",
+    "flax.linen.jit", "nn.jit",
+}
+PARTIAL_WRAPPERS = {"functools.partial", "partial"}
+
+
+@dataclass
+class JitInfo:
+    """Tracing metadata attached to one jitted function/lambda."""
+
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
+
+    def merge(self, other: "JitInfo") -> "JitInfo":
+        return JitInfo(
+            static_argnums=tuple(sorted(set(self.static_argnums) | set(other.static_argnums))),
+            static_argnames=tuple(sorted(set(self.static_argnames) | set(other.static_argnames))),
+            donate_argnums=tuple(sorted(set(self.donate_argnums) | set(other.donate_argnums))),
+            donate_argnames=tuple(sorted(set(self.donate_argnames) | set(other.donate_argnames))),
+        )
+
+
+def _const_ints(node: ast.AST) -> tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                out.append(elt.value)
+        return tuple(out)
+    return ()
+
+
+def _const_strs(node: ast.AST) -> tuple[str, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value, str))
+    return ()
+
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+ScopeNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.Module)
+
+
+@dataclass
+class LinearStmt:
+    """One statement in execution-ish order within a scope.
+
+    ``loop_depth`` counts enclosing loops *within the scope*; ``branch``
+    is the chain of (if-node-id, arm) choices that guard the statement, so
+    order-sensitive rules can tell mutually-exclusive arms apart.
+    """
+
+    stmt: ast.stmt
+    loop_depth: int
+    branch: tuple[tuple[int, int], ...] = ()
+
+    def exclusive_with(self, other: "LinearStmt") -> bool:
+        """True when the two statements sit on opposite arms of some branch
+        (at most one of them runs in any given execution)."""
+        mine = dict(self.branch)
+        for node_id, arm in other.branch:
+            if node_id in mine and mine[node_id] != arm:
+                return True
+        return False
+
+
+class ModuleAnalysis:
+    def __init__(self, tree: ast.Module, source: str, path: str):
+        self.tree = tree
+        self.path = path
+        self.source_lines = source.splitlines()
+
+        self.parent: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parent[child] = node
+
+        # local name -> canonical dotted target
+        self.aliases: dict[str, str] = {}
+        self._collect_imports()
+
+        self.defs_by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, FuncNode):
+                self.defs_by_name.setdefault(node.name, []).append(node)
+
+        # jitted function/lambda node -> JitInfo
+        self.jit_infos: dict[ast.AST, JitInfo] = {}
+        # scope node id -> {callable name: donate_argnums}
+        self.donated_callables: dict[int, dict[str, tuple[int, ...]]] = {}
+        self._collect_jit()
+
+        # node id -> jitted root node (innermost registration wins the
+        # setdefault; for the param set only the root's info matters)
+        self.jit_root: dict[int, ast.AST] = {}
+        # jitted root id -> names that are traced values inside the region
+        self.traced_params: dict[int, set[str]] = {}
+        for root, info in self.jit_infos.items():
+            params: set[str] = set()
+            for n in ast.walk(root):
+                if isinstance(n, FuncNode) or isinstance(n, ast.Lambda):
+                    params |= self._param_names(n, info if n is root else None)
+            self.traced_params[id(root)] = params
+            for n in ast.walk(root):
+                self.jit_root.setdefault(id(n), root)
+
+    # -- source helpers ------------------------------------------------------
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.source_lines):
+            return self.source_lines[lineno - 1]
+        return ""
+
+    # -- name resolution -----------------------------------------------------
+
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.aliases[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    target = f"{mod}.{a.name}" if mod else a.name
+                    self.aliases[local] = target
+
+    def dotted(self, node: ast.AST) -> Optional[str]:
+        parts: list[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            parts.append(node.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def resolve(self, dotted: str) -> str:
+        head, sep, rest = dotted.partition(".")
+        target = self.aliases.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def resolve_call(self, call: ast.Call) -> Optional[str]:
+        d = self.dotted(call.func)
+        return self.resolve(d) if d else None
+
+    @staticmethod
+    def last_segment(node: ast.AST) -> Optional[str]:
+        """Terminal attribute/name of a call target: ``self.x.barrier`` ->
+        ``barrier`` — matches methods regardless of the receiver."""
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+    # -- jit index -----------------------------------------------------------
+
+    def _jit_kwargs(self, call: ast.Call) -> JitInfo:
+        info = JitInfo()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                info.static_argnums = _const_ints(kw.value)
+            elif kw.arg == "static_argnames":
+                info.static_argnames = _const_strs(kw.value)
+            elif kw.arg == "donate_argnums":
+                info.donate_argnums = _const_ints(kw.value)
+            elif kw.arg == "donate_argnames":
+                info.donate_argnames = _const_strs(kw.value)
+        return info
+
+    def _add_jit(self, node: ast.AST, info: JitInfo) -> None:
+        prev = self.jit_infos.get(node)
+        self.jit_infos[node] = prev.merge(info) if prev else info
+
+    def _decorator_jit_info(self, dec: ast.AST) -> Optional[JitInfo]:
+        d = self.dotted(dec)
+        if d and self.resolve(d) in JIT_WRAPPERS:
+            return JitInfo()
+        if isinstance(dec, ast.Call):
+            fd = self.dotted(dec.func)
+            if fd and self.resolve(fd) in JIT_WRAPPERS:
+                return self._jit_kwargs(dec)
+            # @partial(jax.jit, static_argnames=...)
+            if fd and self.resolve(fd) in PARTIAL_WRAPPERS and dec.args:
+                inner = self.dotted(dec.args[0])
+                if inner and self.resolve(inner) in JIT_WRAPPERS:
+                    return self._jit_kwargs(dec)
+        return None
+
+    def enclosing_scope(self, node: ast.AST) -> ast.AST:
+        cur = self.parent.get(node)
+        while cur is not None and not isinstance(cur, ScopeNode):
+            cur = self.parent.get(cur)
+        return cur if cur is not None else self.tree
+
+    def _collect_jit(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, FuncNode):
+                for dec in node.decorator_list:
+                    info = self._decorator_jit_info(dec)
+                    if info is not None:
+                        self._add_jit(node, info)
+                        if info.donate_argnums or info.donate_argnames:
+                            scope = self.enclosing_scope(node)
+                            nums = self._donate_indices(node, info)
+                            self.donated_callables.setdefault(
+                                id(scope), {})[node.name] = nums
+            elif isinstance(node, ast.Call):
+                resolved = self.resolve_call(node)
+                if resolved not in JIT_WRAPPERS or not node.args:
+                    continue
+                info = self._jit_kwargs(node)
+                first = node.args[0]
+                # every def/lambda reachable by name inside the wrapped
+                # expression is traced (covers jax.jit(jax.grad(f)) too)
+                for sub in ast.walk(first):
+                    if isinstance(sub, ast.Lambda):
+                        self._add_jit(sub, info)
+                    elif isinstance(sub, ast.Name):
+                        for d in self.defs_by_name.get(sub.id, []):
+                            self._add_jit(d, info)
+                if info.donate_argnums or info.donate_argnames:
+                    nums = info.donate_argnums
+                    if isinstance(first, ast.Name):
+                        for d in self.defs_by_name.get(first.id, []):
+                            nums = self._donate_indices(d, info)
+                            break
+                    assign = self.parent.get(node)
+                    targets: list[ast.AST] = []
+                    if isinstance(assign, ast.Assign):
+                        targets = list(assign.targets)
+                    elif isinstance(assign, ast.AnnAssign) and assign.target is not None:
+                        targets = [assign.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            scope = self.enclosing_scope(assign)
+                            self.donated_callables.setdefault(
+                                id(scope), {})[t.id] = nums
+
+    @staticmethod
+    def _param_names(fn: ast.AST, root_info: Optional[JitInfo]) -> set[str]:
+        a = fn.args
+        ordered = [x.arg for x in (a.posonlyargs + a.args)]
+        names = set(ordered) | {x.arg for x in a.kwonlyargs}
+        if root_info is not None:
+            static = set(root_info.static_argnames)
+            for i in root_info.static_argnums:
+                if 0 <= i < len(ordered):
+                    static.add(ordered[i])
+            names -= static
+        return names - {"self", "cls"}
+
+    def _donate_indices(self, fn: ast.AST, info: JitInfo) -> tuple[int, ...]:
+        """donate_argnames folded into positional indices via the def."""
+        nums = set(info.donate_argnums)
+        if info.donate_argnames and isinstance(fn, FuncNode):
+            a = fn.args
+            ordered = [x.arg for x in (a.posonlyargs + a.args)]
+            for name in info.donate_argnames:
+                if name in ordered:
+                    nums.add(ordered.index(name))
+        return tuple(sorted(nums))
+
+    def in_jit(self, node: ast.AST) -> Optional[ast.AST]:
+        return self.jit_root.get(id(node))
+
+    # -- scopes / statement order --------------------------------------------
+
+    def scopes(self) -> Iterator[tuple[ast.AST, list[ast.stmt]]]:
+        """(scope node, body) for the module and every def — each analyzed
+        independently by the order-sensitive rules."""
+        yield self.tree, self.tree.body
+        for node in ast.walk(self.tree):
+            if isinstance(node, FuncNode):
+                yield node, node.body
+
+    def linearize(self, body: list[ast.stmt], loop_depth: int = 0,
+                  branch: tuple = ()) -> Iterator[LinearStmt]:
+        """Flatten a scope body into approximate execution order without
+        descending into nested defs (separate scopes) — loops bump
+        ``loop_depth``, if/try arms carry exclusivity markers."""
+        for stmt in body:
+            yield LinearStmt(stmt, loop_depth, branch)
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                yield from self.linearize(stmt.body, loop_depth + 1, branch)
+                yield from self.linearize(stmt.orelse, loop_depth, branch)
+            elif isinstance(stmt, ast.If):
+                key = id(stmt)
+                yield from self.linearize(stmt.body, loop_depth,
+                                          branch + ((key, 0),))
+                yield from self.linearize(stmt.orelse, loop_depth,
+                                          branch + ((key, 1),))
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                yield from self.linearize(stmt.body, loop_depth, branch)
+            elif isinstance(stmt, ast.Try):
+                key = id(stmt)
+                yield from self.linearize(stmt.body, loop_depth,
+                                          branch + ((key, 0),))
+                for i, handler in enumerate(stmt.handlers):
+                    yield from self.linearize(handler.body, loop_depth,
+                                              branch + ((key, i + 1),))
+                yield from self.linearize(stmt.orelse, loop_depth,
+                                          branch + ((key, 0),))
+                yield from self.linearize(stmt.finalbody, loop_depth, branch)
+
+    @staticmethod
+    def stmt_calls(stmt: ast.stmt) -> Iterator[ast.Call]:
+        """Call nodes executed *by this statement* — nested defs/lambdas
+        run later (or never), so their bodies are excluded; for compound
+        statements only the header (test/iter/items) counts, the body is
+        linearized separately."""
+        for node in _walk_shallow(stmt):
+            if isinstance(node, ast.Call):
+                yield node
+
+    @staticmethod
+    def deep_calls(stmt: ast.AST) -> Iterator[ast.Call]:
+        """Every Call anywhere under ``stmt`` except inside nested
+        function/lambda bodies — for containment rules (DCR005)."""
+        stack: list[ast.AST] = [stmt]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.Call):
+                yield node
+            if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def bound_names(stmt: ast.stmt) -> set[str]:
+        """Names (re)bound by this statement, including tuple unpacking,
+        loop targets, with-as, and walrus."""
+        out: set[str] = set()
+        for node in _walk_shallow(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+                out.add(node.id)
+        return out
+
+    @staticmethod
+    def loaded_names(stmt: ast.stmt) -> set[str]:
+        out: set[str] = set()
+        for node in _walk_shallow(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out.add(node.id)
+        return out
+
+
+_COMPOUND = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+             ast.AsyncWith, ast.Try)
+_BODY_FIELDS = {"body", "orelse", "handlers", "finalbody"}
+
+
+def _walk_shallow(stmt: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk restricted to what *this statement itself* executes: no
+    nested function/lambda bodies (deferred; separate scopes) and no
+    compound-statement bodies (linearized as separate statements — only the
+    if-test / for-iter / with-items header belongs to this node)."""
+    stack: list[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            continue  # deferred body: a `def` statement only binds the name
+        for fieldname, value in ast.iter_fields(node):
+            if isinstance(node, _COMPOUND) and fieldname in _BODY_FIELDS:
+                continue
+            if isinstance(value, ast.AST):
+                stack.append(value)
+            elif isinstance(value, list):
+                stack.extend(v for v in value if isinstance(v, ast.AST))

@@ -1,0 +1,205 @@
+"""``[tool.dcr-lint]`` configuration loading.
+
+Rule sets are declared in pyproject.toml, not hardcoded::
+
+    [tool.dcr-lint]
+    select = ["DCR001", ...]        # rules to run (default: all)
+    ignore = ["DCR0xx"]             # rules to drop after select
+    exclude = ["tests/fixtures"]    # path prefixes never scanned
+    baseline = "tools/lint/baseline.json"
+
+    [tool.dcr-lint.per-path-ignores]
+    "tools/" = ["DCR008"]           # rule ids ignored under a path prefix
+
+Python 3.11+ parses with stdlib tomllib; on 3.10 (this container) a
+minimal TOML-subset reader handles the constructs pyproject.toml actually
+uses (tables, strings, ints/floats/bools, string arrays, inline tables).
+No third-party dependency either way — the lint job must run on a bare
+checkout before anything is pip-installed.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+
+@dataclass
+class LintConfig:
+    select: tuple[str, ...] = ()          # empty = all registered rules
+    ignore: tuple[str, ...] = ()
+    exclude: tuple[str, ...] = ("__pycache__",)
+    per_path_ignores: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    baseline: Optional[str] = "tools/lint/baseline.json"
+    root: Path = Path(".")
+
+    def rules_for(self, relpath: str, all_rules: tuple[str, ...]) -> set[str]:
+        selected = set(self.select or all_rules) - set(self.ignore)
+        posix = relpath.replace("\\", "/")
+        for prefix, ignored in self.per_path_ignores.items():
+            if posix.startswith(prefix.rstrip("/") + "/") or posix == prefix:
+                selected -= set(ignored)
+        return selected
+
+    def excluded(self, relpath: str) -> bool:
+        posix = relpath.replace("\\", "/")
+        parts = posix.split("/")
+        for pat in self.exclude:
+            pat = pat.rstrip("/")
+            if posix == pat or posix.startswith(pat + "/") or pat in parts:
+                return True
+        return False
+
+
+def _parse_toml(text: str) -> dict:
+    try:
+        import tomllib
+
+        return tomllib.loads(text)
+    except ModuleNotFoundError:
+        return _mini_toml(text)
+
+
+_KEY_RE = re.compile(r'^\s*(?:"([^"]+)"|([A-Za-z0-9_.-]+))\s*=\s*(.*)$')
+
+
+def _split_table_path(header: str) -> list[str]:
+    """Dotted table header -> segments, honoring quoted segments."""
+    out, cur, quoted = [], "", False
+    for ch in header:
+        if ch == '"':
+            quoted = not quoted
+        elif ch == "." and not quoted:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    out.append(cur)
+    return [s.strip() for s in out]
+
+
+def _parse_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("["):
+        # tolerate trailing commas / newlines already joined by caller
+        inner = raw[1:-1] if raw.endswith("]") else raw[1:]
+        items = [x.strip() for x in _split_commas(inner) if x.strip()]
+        return [_parse_value(x) for x in items]
+    if raw.startswith("{"):
+        inner = raw[1:-1] if raw.endswith("}") else raw[1:]
+        out = {}
+        for part in _split_commas(inner):
+            m = _KEY_RE.match(part.strip())
+            if m:
+                out[m.group(1) or m.group(2)] = _parse_value(m.group(3))
+        return out
+    if raw.startswith('"'):
+        try:
+            return json.loads(raw)
+        except json.JSONDecodeError:
+            return raw.strip('"')
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        return raw
+
+
+def _split_commas(text: str) -> list[str]:
+    out, cur, depth, quoted = [], "", 0, False
+    for ch in text:
+        if ch == '"':
+            quoted = not quoted
+        if not quoted:
+            if ch in "[{":
+                depth += 1
+            elif ch in "]}":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                out.append(cur)
+                cur = ""
+                continue
+        cur += ch
+    if cur.strip():
+        out.append(cur)
+    return out
+
+
+def _strip_comment(line: str) -> str:
+    out, quoted = "", False
+    for ch in line:
+        if ch == '"':
+            quoted = not quoted
+        if ch == "#" and not quoted:
+            break
+        out += ch
+    return out
+
+
+def _mini_toml(text: str) -> dict:
+    root: dict = {}
+    table = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        line = _strip_comment(lines[i]).strip()
+        i += 1
+        if not line:
+            continue
+        if line.startswith("["):
+            path = _split_table_path(line.strip("[]"))
+            table = root
+            for seg in path:
+                table = table.setdefault(seg, {})
+            continue
+        m = _KEY_RE.match(line)
+        if not m:
+            continue
+        key = m.group(1) or m.group(2)
+        raw = m.group(3).strip()
+        # multiline arrays: keep consuming until brackets balance
+        while raw.count("[") > raw.count("]") and i < len(lines):
+            raw += " " + _strip_comment(lines[i]).strip()
+            i += 1
+        table[key] = _parse_value(raw)
+    return root
+
+
+def find_pyproject(start: Path) -> Optional[Path]:
+    cur = start.resolve()
+    for candidate in [cur, *cur.parents]:
+        p = candidate / "pyproject.toml"
+        if p.is_file():
+            return p
+    return None
+
+
+def load_config(pyproject: Optional[Path] = None,
+                start: Optional[Path] = None) -> LintConfig:
+    if pyproject is None:
+        pyproject = find_pyproject(start or Path.cwd())
+    if pyproject is None or not pyproject.is_file():
+        return LintConfig()
+    data = _parse_toml(pyproject.read_text(encoding="utf-8"))
+    section = data.get("tool", {}).get("dcr-lint", {})
+    if not isinstance(section, dict):
+        section = {}
+    ppi = section.get("per-path-ignores", {})
+    cfg = LintConfig(
+        select=tuple(section.get("select", ())),
+        ignore=tuple(section.get("ignore", ())),
+        exclude=tuple(section.get("exclude", ("__pycache__",))),
+        per_path_ignores={k: tuple(v) for k, v in ppi.items()
+                          if isinstance(v, list)},
+        baseline=section.get("baseline", "tools/lint/baseline.json"),
+        root=pyproject.parent,
+    )
+    return cfg

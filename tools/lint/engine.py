@@ -1,0 +1,247 @@
+"""dcr-lint scan driver: file discovery, pragmas, baseline, reporting.
+
+Suppression model (two layers, both auditable):
+
+- **pragma** — ``# dcr-lint: disable=DCR004`` (comma-separated ids, or
+  ``all``) on the finding's line silences it at the source, next to the
+  justifying comment;
+- **baseline** — ``tools/lint/baseline.json`` grandfathers findings by
+  (rule, path, stripped-source-line) so unrelated edits that shift line
+  numbers don't invalidate it. Every entry MUST carry a non-empty written
+  justification; an unjustified entry is a configuration error (exit 2),
+  not a suppression. Stale entries (matching nothing) are reported so the
+  baseline only ever shrinks.
+
+Exit codes: 0 clean, 1 findings, 2 internal/config error — the contract
+the ``static-analysis`` CI job relies on.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional, Sequence
+
+from tools.lint.analysis import ModuleAnalysis
+from tools.lint.config import LintConfig
+from tools.lint.rules import RULES, Finding
+
+JSON_SCHEMA_VERSION = 1
+
+_PRAGMA_RE = re.compile(r"#\s*dcr-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+class LintError(Exception):
+    """Configuration/usage problem (bad baseline, unreadable path) — exit 2."""
+
+
+@dataclass
+class Report:
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    pragma_suppressed: int = 0
+    baseline_suppressed: int = 0
+    stale_baseline: list[dict] = field(default_factory=list)
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> dict:
+        return {
+            "version": JSON_SCHEMA_VERSION,
+            "files_scanned": self.files_scanned,
+            "findings": [
+                {"rule": f.rule, "path": f.path, "line": f.line,
+                 "col": f.col, "message": f.message, "snippet": f.snippet}
+                for f in self.findings
+            ],
+            "counts": self.counts(),
+            "suppressed": {"pragma": self.pragma_suppressed,
+                           "baseline": self.baseline_suppressed},
+            "stale_baseline": self.stale_baseline,
+        }
+
+
+def _pragma_rules(line: str) -> set[str]:
+    m = _PRAGMA_RE.search(line)
+    if not m:
+        return set()
+    return {tok.strip().upper() for tok in m.group(1).split(",") if tok.strip()}
+
+
+def lint_source_counted(source: str, path: str = "<string>",
+                        rules: Optional[Sequence[str]] = None
+                        ) -> tuple[list[Finding], int]:
+    """(findings, pragma-suppressed count) for one source blob."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding(rule="DCR000", path=path, line=e.lineno or 1,
+                        col=e.offset or 0,
+                        message=f"syntax error: {e.msg}", snippet="")], 0
+    analysis = ModuleAnalysis(tree, source, path)
+    findings: list[Finding] = []
+    for rule_id in (rules if rules is not None else RULES):
+        rule = RULES.get(rule_id)
+        if rule is None:
+            raise LintError(f"unknown rule id {rule_id!r} "
+                            f"(known: {', '.join(sorted(RULES))})")
+        findings.extend(rule.check(analysis))
+    # dedupe: containment rules can reach the same node via nested contexts
+    findings = list(dict.fromkeys(findings))
+    kept, suppressed = [], 0
+    for f in findings:
+        disabled = _pragma_rules(analysis.line(f.line))
+        if f.rule in disabled or "ALL" in disabled:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def lint_source(source: str, path: str = "<string>",
+                rules: Optional[Sequence[str]] = None) -> list[Finding]:
+    """Run the (selected) checkers over one source blob; pragma-filtered,
+    baseline-free. The in-process API tests and tools build on."""
+    return lint_source_counted(source, path, rules)[0]
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+UNJUSTIFIED = "UNJUSTIFIED"
+
+
+def load_baseline(path: Path) -> list[dict]:
+    if not path.is_file():
+        return []
+    try:
+        data = json.loads(path.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as e:
+        raise LintError(f"baseline {path}: unreadable ({e})") from e
+    entries = data.get("entries", [])
+    for entry in entries:
+        for key in ("rule", "path", "snippet", "justification"):
+            if key not in entry:
+                raise LintError(f"baseline {path}: entry missing {key!r}: "
+                                f"{json.dumps(entry)[:120]}")
+        just = entry["justification"].strip()
+        if not just or just.upper().startswith(UNJUSTIFIED) or \
+                just.upper().startswith("TODO"):
+            raise LintError(
+                f"baseline {path}: {entry['rule']} at {entry['path']} has no "
+                "written justification — every grandfathered finding must "
+                "say why it is acceptable")
+    return entries
+
+
+def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
+    counts: dict[tuple[str, str, str], int] = {}
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    entries = [
+        {"rule": rule, "path": fpath, "snippet": snippet,
+         **({"count": n} if n > 1 else {}),
+         "justification": f"{UNJUSTIFIED}: replace with why this is acceptable"}
+        for (rule, fpath, snippet), n in counts.items()
+    ]
+    payload = {
+        "comment": ("dcr-lint baseline: grandfathered findings, matched by "
+                    "(rule, path, stripped source line). Every entry must "
+                    "carry a real justification or the lint run fails."),
+        "entries": entries,
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+
+
+# ---------------------------------------------------------------------------
+# scanning
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Sequence[Path], cfg: LintConfig) -> list[Path]:
+    out: list[Path] = []
+    seen = set()
+    for p in paths:
+        if not p.exists():
+            raise LintError(f"no such path: {p}")
+        if p.is_file() and p.suffix != ".py":
+            # an explicitly named file that would be silently skipped is a
+            # misconfigured invocation, not a clean scan
+            raise LintError(f"not a Python file: {p}")
+        candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
+        for c in candidates:
+            rel = _relpath(c, cfg.root)
+            if cfg.excluded(rel):
+                continue
+            if c not in seen:
+                seen.add(c)
+                out.append(c)
+    return out
+
+
+def _relpath(p: Path, root: Path) -> str:
+    try:
+        return p.resolve().relative_to(root.resolve()).as_posix()
+    except ValueError:
+        return p.as_posix()
+
+
+def scan(paths: Sequence[str | Path], cfg: Optional[LintConfig] = None, *,
+         use_baseline: bool = True,
+         baseline_override: Optional[Path] = None) -> Report:
+    cfg = cfg or LintConfig()
+    report = Report()
+    all_rules = tuple(RULES)
+    raw: list[Finding] = []
+    scanned_rel: set[str] = set()
+    for path in iter_py_files([Path(p) for p in paths], cfg):
+        rel = _relpath(path, cfg.root)
+        selected = cfg.rules_for(rel, all_rules)
+        if not selected:
+            continue
+        scanned_rel.add(rel)
+        source = path.read_text(encoding="utf-8", errors="replace")
+        found, n_pragma = lint_source_counted(source, rel, rules=sorted(selected))
+        report.pragma_suppressed += n_pragma
+        raw.extend(found)
+        report.files_scanned += 1
+
+    entries: list[dict] = []
+    if use_baseline:
+        bl_path = baseline_override
+        if bl_path is None and cfg.baseline:
+            bl_path = cfg.root / cfg.baseline
+        if bl_path is not None:
+            entries = load_baseline(Path(bl_path))
+    # each entry suppresses at most `count` occurrences (default 1): one
+    # grandfathered finding must never silently absolve a NEW duplicate of
+    # the same pattern added to the same file later
+    matched_entries: set[int] = set()
+    budget = [int(e.get("count", 1)) for e in entries]
+    for f in raw:
+        suppressed = False
+        for i, entry in enumerate(entries):
+            if budget[i] > 0 and \
+                    (entry["rule"], entry["path"], entry["snippet"]) == f.key():
+                matched_entries.add(i)
+                budget[i] -= 1
+                suppressed = True
+                break
+        if suppressed:
+            report.baseline_suppressed += 1
+        else:
+            report.findings.append(f)
+    # an entry is stale only when its file WAS scanned and nothing matched —
+    # partial scans (one file, a subdir) must not cry wolf about the rest
+    report.stale_baseline = [e for i, e in enumerate(entries)
+                             if i not in matched_entries
+                             and e["path"] in scanned_rel]
+    report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return report

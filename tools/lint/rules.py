@@ -1,0 +1,533 @@
+"""The dcr-lint rule set (DCR001–DCR008).
+
+Each checker is a function ``(ModuleAnalysis) -> list[Finding]`` registered
+in :data:`RULES`. Every rule is motivated by a real hazard class in this
+repo — see the rule table in README.md §"Static analysis" and the
+footgun-to-rule mapping in MIGRATION.md. Checkers are deliberately
+precision-biased: module-local, name-based, no cross-file inference. The
+escape hatches for the residue are per-line pragmas and the justified
+baseline, both enforced by tools/lint/engine.py.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from tools.lint.analysis import FuncNode, LinearStmt, ModuleAnalysis
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    def key(self) -> tuple[str, str, str]:
+        """Line-number-free identity used for baseline matching (stable
+        across unrelated edits that shift line numbers)."""
+        return (self.rule, self.path, self.snippet)
+
+
+def _finding(analysis: ModuleAnalysis, rule: str, node: ast.AST,
+             message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    col = getattr(node, "col_offset", 0)
+    return Finding(rule=rule, path=analysis.path, line=line, col=col,
+                   message=message, snippet=analysis.line(line).strip())
+
+
+# ---------------------------------------------------------------------------
+# DCR001 — host sync / tracer leak inside a jitted function
+# ---------------------------------------------------------------------------
+
+# zero/low-arg array methods that force a device->host transfer (or make no
+# sense on a tracer at all)
+_HOST_SYNC_METHODS = {"item", "tolist", "numpy", "block_until_ready",
+                      "copy_to_host_async"}
+_HOST_SYNC_CALLS = {"jax.device_get"}
+_PY_CASTS = {"float", "int", "bool", "complex"}
+
+
+def check_dcr001(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        root = analysis.in_jit(node)
+        if root is None:
+            continue
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _HOST_SYNC_METHODS:
+            out.append(_finding(
+                analysis, "DCR001", node,
+                f".{node.func.attr}() inside a jitted function forces a "
+                "host sync (or fails on a tracer) — return the array and "
+                "materialize outside jit"))
+            continue
+        resolved = analysis.resolve_call(node)
+        if resolved is None:
+            continue
+        if resolved in _HOST_SYNC_CALLS:
+            out.append(_finding(
+                analysis, "DCR001", node,
+                f"{resolved} inside a jitted function is a host transfer — "
+                "hoist it out of the traced region"))
+        elif resolved.split(".")[0] == "numpy":
+            out.append(_finding(
+                analysis, "DCR001", node,
+                f"host numpy call ({resolved.replace('numpy', 'np', 1)}) "
+                "inside a jitted function — it either bakes a constant at "
+                "trace time or fails on a tracer; use jnp"))
+        elif resolved in _PY_CASTS and node.args:
+            traced = analysis.traced_params.get(id(root), set())
+            arg = node.args[0]
+            if isinstance(arg, ast.Name) and arg.id in traced:
+                out.append(_finding(
+                    analysis, "DCR001", node,
+                    f"{resolved}({arg.id}) casts a traced argument to a "
+                    "Python scalar inside jit — a host sync (ConcretizationError "
+                    "on abstract tracers); keep it as a jnp array"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR002 — donation-after-use
+# ---------------------------------------------------------------------------
+
+def check_dcr002(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    module_donated = analysis.donated_callables.get(id(analysis.tree), {})
+    for scope, body in analysis.scopes():
+        donated = dict(module_donated)
+        donated.update(analysis.donated_callables.get(id(scope), {}))
+        if not donated:
+            continue
+        stmts = list(analysis.linearize(body))
+        for i, ls in enumerate(stmts):
+            for call in analysis.stmt_calls(ls.stmt):
+                if not isinstance(call.func, ast.Name):
+                    continue
+                indices = donated.get(call.func.id)
+                if not indices:
+                    continue
+                for k in indices:
+                    if k >= len(call.args) or not isinstance(call.args[k], ast.Name):
+                        continue
+                    name = call.args[k].id
+                    if name in analysis.bound_names(ls.stmt):
+                        continue  # x, ... = f(x, ...) — the donated name is rebound
+                    if ls.loop_depth > 0:
+                        out.append(_finding(
+                            analysis, "DCR002", call,
+                            f"'{name}' is donated to {call.func.id}() inside a "
+                            "loop but never rebound — the second iteration "
+                            "passes a buffer XLA already freed; rebind it "
+                            f"(e.g. `{name}, ... = {call.func.id}({name}, ...)`)"))
+                        continue
+                    out.extend(_use_after_donation(analysis, stmts, i, ls,
+                                                   name, call))
+    return out
+
+
+def _use_after_donation(analysis: ModuleAnalysis, stmts: list[LinearStmt],
+                        i: int, donate_ls: LinearStmt, name: str,
+                        call: ast.Call) -> list[Finding]:
+    for later in stmts[i + 1:]:
+        if later.exclusive_with(donate_ls):
+            continue
+        if name in analysis.loaded_names(later.stmt):
+            return [_finding(
+                analysis, "DCR002", later.stmt,
+                f"'{name}' is read after being donated to "
+                f"{call.func.id}() on line {call.lineno} — donate_argnums "
+                "freed/aliased that buffer (undefined contents); read it "
+                "before the call or drop the donation")]
+        if name in analysis.bound_names(later.stmt):
+            return []
+    return []
+
+
+# ---------------------------------------------------------------------------
+# DCR003 — RNG key reuse
+# ---------------------------------------------------------------------------
+
+# producers: calls whose result is a fresh key (assignment target becomes a
+# tracked key variable); last-segment match covers jax.random.* and the
+# repo's core.rng helpers alike
+_KEY_PRODUCERS = {"key", "PRNGKey", "split", "fold_in", "root_key",
+                  "stream_key", "step_key", "wrap_key_data", "clone"}
+# consumers: sampling calls that exhaust the key passed as arg 0 / key=
+_KEY_CONSUMERS = {
+    "normal", "uniform", "randint", "bits", "beta", "gamma", "poisson",
+    "bernoulli", "categorical", "choice", "permutation", "shuffle",
+    "truncated_normal", "dirichlet", "exponential", "laplace", "logistic",
+    "gumbel", "cauchy", "rademacher", "maxwell", "t", "orthogonal", "ball",
+    "loggamma", "binomial", "multivariate_normal", "double_sided_maxwell",
+    "generalized_normal", "rayleigh", "triangular", "weibull_min",
+}
+
+
+def _is_jax_random(analysis: ModuleAnalysis, call: ast.Call,
+                   vocabulary: set[str]) -> Optional[str]:
+    """The terminal fn name when this call is jax.random.<fn> (or an aliased
+    spelling / repo rng helper) with <fn> in ``vocabulary``."""
+    last = analysis.last_segment(call.func)
+    if last not in vocabulary:
+        return None
+    resolved = analysis.resolve_call(call) or ""
+    head = resolved.rsplit(".", 1)[0] if "." in resolved else ""
+    # exclude stdlib random / numpy.random — DCR008 territory
+    if head == "random" or head.startswith("numpy"):
+        return None
+    return last
+
+
+def _consumed_key(call: ast.Call) -> Optional[str]:
+    for kw in call.keywords:
+        if kw.arg == "key" and isinstance(kw.value, ast.Name):
+            return kw.value.id
+    if call.args and isinstance(call.args[0], ast.Name):
+        return call.args[0].id
+    return None
+
+
+def check_dcr003(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for scope, body in analysis.scopes():
+        key_depth: dict[str, int] = {}          # key var -> binding loop depth
+        consumed: dict[str, LinearStmt] = {}    # key var -> first consuming stmt
+        consumed_line: dict[str, int] = {}
+        # seed: conventionally-named key parameters are keys from line one
+        for p in _param_key_names(scope):
+            key_depth[p] = 0
+        for ls in analysis.linearize(body):
+            for call in analysis.stmt_calls(ls.stmt):
+                if _is_jax_random(analysis, call, _KEY_CONSUMERS) is None:
+                    continue
+                name = _consumed_key(call)
+                if name is None or name not in key_depth:
+                    continue
+                prev = consumed.get(name)
+                if prev is not None and not prev.exclusive_with(ls):
+                    out.append(_finding(
+                        analysis, "DCR003", call,
+                        f"RNG key '{name}' is consumed again (first used on "
+                        f"line {consumed_line[name]}) without split/fold_in — "
+                        "identical randomness in both draws breaks the "
+                        "one-use-per-key discipline"))
+                    continue
+                if ls.loop_depth > key_depth.get(name, 0):
+                    out.append(_finding(
+                        analysis, "DCR003", call,
+                        f"RNG key '{name}' (bound outside this loop) is "
+                        "consumed every iteration — every draw is identical; "
+                        "fold_in the loop index or split per iteration"))
+                    continue
+                consumed[name] = ls
+                consumed_line[name] = call.lineno
+            bound = analysis.bound_names(ls.stmt)
+            for name in bound:
+                consumed.pop(name, None)
+                consumed_line.pop(name, None)
+            # track fresh key bindings: <targets> = <producer>(...)
+            for call in analysis.stmt_calls(ls.stmt):
+                if _is_jax_random(analysis, call, _KEY_PRODUCERS) is not None:
+                    for name in bound:
+                        key_depth[name] = ls.loop_depth
+                    break
+    return out
+
+
+def _param_key_names(fn: ast.AST) -> list[str]:
+    if not isinstance(fn, FuncNode):
+        return []
+    a = fn.args
+    return [x.arg for x in (a.posonlyargs + a.args + a.kwonlyargs)
+            if x.arg in ("key", "rng", "rng_key", "prng_key", "root_key")]
+
+
+# ---------------------------------------------------------------------------
+# DCR004 — unbounded collectives
+# ---------------------------------------------------------------------------
+
+# collective -> index of its timeout positional parameter
+_BOUNDED_COLLECTIVES = {"barrier": 1, "wait_at_barrier": 1, "kv_allgather": 2}
+# collectives with no timeout parameter at all: only OK under run_with_timeout
+_WRAP_ONLY_COLLECTIVES = {"sync_global_devices", "process_allgather"}
+_TIMEOUT_KWARGS = {"timeout_s", "timeout_ms", "timeout_in_ms", "timeout"}
+
+
+def _is_zero(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value in (0, 0.0)
+
+
+def _under_run_with_timeout(analysis: ModuleAnalysis, node: ast.AST) -> bool:
+    cur = node
+    while cur is not None:
+        if isinstance(cur, ast.Call) and \
+                analysis.last_segment(cur.func) == "run_with_timeout":
+            return True
+        cur = analysis.parent.get(cur)
+    return False
+
+
+def check_dcr004(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = analysis.last_segment(node.func)
+        if last in _BOUNDED_COLLECTIVES:
+            pos = _BOUNDED_COLLECTIVES[last]
+            bounded = None
+            if len(node.args) > pos:
+                bounded = not _is_zero(node.args[pos])
+            for kw in node.keywords:
+                if kw.arg in _TIMEOUT_KWARGS:
+                    bounded = not _is_zero(kw.value)
+            if bounded is None:
+                bounded = _under_run_with_timeout(analysis, node)
+            if not bounded:
+                out.append(_finding(
+                    analysis, "DCR004", node,
+                    f"{last}() without a timeout — a dead or wedged peer "
+                    "hangs the pod here forever; pass timeout_s (the "
+                    "BarrierTimeout discipline, core/dist.py) so the hang "
+                    "watchdog can turn it into a diagnosable abort"))
+        elif last in _WRAP_ONLY_COLLECTIVES:
+            if not _under_run_with_timeout(analysis, node):
+                out.append(_finding(
+                    analysis, "DCR004", node,
+                    f"{last}() has no native deadline — wrap it in "
+                    "dist.run_with_timeout(...) so a missing peer raises "
+                    "BarrierTimeout instead of hanging the pod"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR005 — rank-divergent collectives
+# ---------------------------------------------------------------------------
+
+_RANK_CALLS = {"process_index", "is_primary"}
+_RANK_NAMES = {"rank", "process_id", "process_index", "pidx"}
+_COLLECTIVE_CALLS = (set(_BOUNDED_COLLECTIVES) | _WRAP_ONLY_COLLECTIVES |
+                     {"psum", "pmean", "pmax", "pmin", "all_gather",
+                      "all_reduce", "all_to_all", "agree_int", "assert_same",
+                      "exchange", "ppermute"})
+
+
+def _rank_conditional(analysis: ModuleAnalysis, test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call) and \
+                analysis.last_segment(node.func) in _RANK_CALLS:
+            return True
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            if any(isinstance(s, ast.Name) and s.id in _RANK_NAMES
+                   for s in sides):
+                return True
+    return False
+
+
+def check_dcr005(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.If) or not _rank_conditional(analysis, node.test):
+            continue
+        for arm in (node.body, node.orelse):
+            for stmt in arm:
+                for call in analysis.deep_calls(stmt):
+                    last = analysis.last_segment(call.func)
+                    if last in _COLLECTIVE_CALLS:
+                        out.append(_finding(
+                            analysis, "DCR005", call,
+                            f"collective {last}() under a rank-conditional "
+                            "branch — the other ranks never enter it and the "
+                            "pod deadlocks; issue the collective on every "
+                            "rank and branch on the (identical) result"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR006 — silent exception swallowing
+# ---------------------------------------------------------------------------
+
+_BROAD_EXC = {"Exception", "BaseException"}
+
+
+def _is_broad(analysis: ModuleAnalysis, type_node: Optional[ast.AST]) -> bool:
+    if type_node is None:
+        return True  # bare except:
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(analysis, e) for e in type_node.elts)
+    last = analysis.last_segment(type_node)
+    return last in _BROAD_EXC
+
+
+def _is_silent(body: list[ast.stmt]) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        return False
+    return True
+
+
+def check_dcr006(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if isinstance(node, ast.ExceptHandler) and \
+                _is_broad(analysis, node.type) and _is_silent(node.body):
+            out.append(_finding(
+                analysis, "DCR006", node,
+                "broad `except ...: pass` swallows the failure with no "
+                "trace — on a recovery path this hides real faults; emit a "
+                "structured log (resilience.log_event) and bump a faults/* "
+                "counter (resilience.bump_counter), or narrow the type"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR007 — recompilation hazards (Python branching on traced values)
+# ---------------------------------------------------------------------------
+
+def _is_none_check(node: ast.AST) -> bool:
+    """``x is None`` / ``x is not None``: a pytree-STRUCTURE check, decided
+    at trace time from the treedef — stable, never touches traced values."""
+    return (isinstance(node, ast.Compare)
+            and all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops)
+            and all(isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators))
+
+
+def _walk_skipping_none_checks(test: ast.AST):
+    stack = [test]
+    while stack:
+        node = stack.pop()
+        if _is_none_check(node):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check_dcr007(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            continue
+        root = analysis.in_jit(node)
+        if root is None:
+            continue
+        traced = analysis.traced_params.get(id(root), set())
+        hits = sorted({n.id for n in _walk_skipping_none_checks(node.test)
+                       if isinstance(n, ast.Name)
+                       and isinstance(n.ctx, ast.Load)
+                       and n.id in traced})
+        if hits:
+            out.append(_finding(
+                analysis, "DCR007", node,
+                f"Python branch on traced argument(s) {', '.join(hits)} "
+                "inside a jitted function — concrete values raise at trace "
+                "time and shape/flag values recompile per variant; mark the "
+                "argument static (static_argnames) or use lax.cond/jnp.where"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR008 — wall-clock / global-RNG nondeterminism
+# ---------------------------------------------------------------------------
+
+_STDLIB_RANDOM_FNS = {
+    "random", "randint", "randrange", "choice", "choices", "shuffle",
+    "sample", "uniform", "gauss", "normalvariate", "seed", "betavariate",
+    "expovariate", "triangular", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "lognormvariate", "getrandbits", "randbytes",
+}
+# numpy.random attributes that are explicitly-seeded generator constructors
+# (deterministic by construction) rather than the hidden global stream
+_NP_RANDOM_SAFE = {
+    "default_rng", "Generator", "RandomState", "SeedSequence", "BitGenerator",
+    "PCG64", "PCG64DXSM", "MT19937", "Philox", "SFC64",
+}
+_WALL_CLOCK = {
+    "time.time", "time.time_ns", "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow", "datetime.date.today",
+}
+
+
+def check_dcr008(analysis: ModuleAnalysis) -> list[Finding]:
+    out = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = analysis.resolve_call(node)
+        if resolved is None:
+            continue
+        if resolved.startswith("numpy.random."):
+            fn = resolved.split(".")[-1]
+            if fn not in _NP_RANDOM_SAFE:
+                out.append(_finding(
+                    analysis, "DCR008", node,
+                    f"np.random.{fn}() uses numpy's hidden global RNG state — "
+                    "order-dependent and resume-unsafe; derive a Generator "
+                    "from core.rng.host_python_rng(seed, stream)"))
+        elif resolved.startswith("random.") and \
+                resolved.split(".")[-1] in _STDLIB_RANDOM_FNS and \
+                resolved.count(".") == 1:
+            out.append(_finding(
+                analysis, "DCR008", node,
+                f"stdlib {resolved}() draws from process-global RNG state — "
+                "nondeterministic under reordering/restart; use an explicit "
+                "seeded stream (core/rng.py)"))
+        elif resolved in _WALL_CLOCK and analysis.in_jit(node) is not None:
+            out.append(_finding(
+                analysis, "DCR008", node,
+                f"{resolved}() inside a jitted function bakes the trace-time "
+                "clock in as a constant — nondeterministic across "
+                "compilations; pass times in as arguments"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Rule:
+    rule_id: str
+    title: str
+    summary: str
+    check: Callable[[ModuleAnalysis], list[Finding]]
+
+
+RULES: dict[str, Rule] = {r.rule_id: r for r in [
+    Rule("DCR001", "host-sync-in-jit",
+         "host sync / tracer leak (.item(), np.*, device_get, casts) inside "
+         "a jitted function", check_dcr001),
+    Rule("DCR002", "donation-after-use",
+         "argument named in donate_argnums is read after the donating call",
+         check_dcr002),
+    Rule("DCR003", "rng-key-reuse",
+         "same RNG key consumed twice without split/fold_in", check_dcr003),
+    Rule("DCR004", "unbounded-collective",
+         "barrier/kv_allgather/allgather call without a timeout",
+         check_dcr004),
+    Rule("DCR005", "rank-divergent-collective",
+         "collective issued under a process_index()==0-style conditional",
+         check_dcr005),
+    Rule("DCR006", "silent-exception-swallow",
+         "broad `except: pass` with no log/counter/quarantine", check_dcr006),
+    Rule("DCR007", "recompilation-hazard",
+         "Python branching on traced arguments inside a jitted function "
+         "without static_argnames", check_dcr007),
+    Rule("DCR008", "nondeterminism",
+         "global random.*/np.random.* state, or wall-clock reads traced "
+         "into jit", check_dcr008),
+]}
