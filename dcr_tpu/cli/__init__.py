@@ -5,6 +5,7 @@
     python -m dcr_tpu.cli.evaluate --query_dir=... --values_dir=...
     python -m dcr_tpu.cli.search   embed|search --...
     python -m dcr_tpu.cli.mitigate --model_path=... [--rand_noise_lam=...]
+    python -m dcr_tpu.cli.serve    --model_path=... --port=8000
 
 Each maps one reference script (diff_train.py, diff_inference.py,
 diff_retrieval.py, embedding_search/*, sd_mitigation.py) onto the library

@@ -17,12 +17,21 @@ from dcr_tpu.sampling.pipeline import generate
 
 
 def infer_modelstyle(model_path: str) -> str:
+    """Conditioning regime from the run's config.json; falls back to
+    "nolevel" — LOUDLY, never silently (DCR006 discipline): a config.json
+    that exists but lacks data.class_prompt usually means a foreign or
+    truncated run dir, and a silent fallback would sample with the wrong
+    prompt regime and poison every downstream replication metric."""
     cfg_file = Path(model_path) / "config.json"
     if cfg_file.exists():
         try:
             return json.loads(cfg_file.read_text())["data"]["class_prompt"]
-        except (KeyError, json.JSONDecodeError):
-            pass
+        except (KeyError, TypeError, json.JSONDecodeError) as e:
+            from dcr_tpu.core.resilience import log_event
+
+            log_event("modelstyle_fallback", path=str(cfg_file),
+                      missing_key="data.class_prompt", error=repr(e),
+                      fallback="nolevel")
     return "nolevel"
 
 

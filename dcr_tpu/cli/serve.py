@@ -1,0 +1,90 @@
+"""dcr-serve: keep a compiled sampler resident and answer generation requests.
+
+No reference equivalent — somepago/DCR only generates offline (diff_inference
+loads, renders a fixed list, exits). This entry point loads the generation
+stack ONCE (the same :func:`load_generation_stack` the bulk pipeline uses, so
+the paths cannot drift), then serves ``POST /generate`` with dynamic batching
+and an embedding cache until SIGTERM, which drains gracefully:
+
+1. admission stops (new requests get 503 ``{"error": "draining"}``,
+   /healthz flips to "draining" so balancers rotate the replica out);
+2. queued + in-flight batches finish and every accepted request receives
+   its response;
+3. the process exits with ``coordination.EXIT_PREEMPTED`` (83) — the same
+   "clean, restart me" code a preempted trainer uses, so one restart
+   wrapper handles both.
+
+A second signal kills the process immediately (escape hatch while stuck in
+a compile). A wedged sampler step trips the hang watchdog (exit 89) when
+``--hang_timeout_s`` is set, instead of leaving a dead port listening.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from dcr_tpu.core.config import (SampleConfig, ServeConfig, parse_cli,
+                                 validate_serve_config)
+
+log = logging.getLogger("dcr_tpu")
+
+
+def main(argv=None) -> None:
+    from dcr_tpu.cli import setup_platform
+
+    setup_platform()
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s", force=True)
+    cfg = parse_cli(ServeConfig, argv)
+    validate_serve_config(cfg)
+
+    from dcr_tpu.core import dist
+    from dcr_tpu.core import resilience as R
+    from dcr_tpu.core.coordination import EXIT_PREEMPTED
+    from dcr_tpu.core.metrics import MetricWriter
+    from dcr_tpu.sampling.pipeline import load_generation_stack
+    from dcr_tpu.serve.server import make_server
+    from dcr_tpu.serve.worker import GenerationService
+
+    dist.initialize()
+    with R.stage("serve_load"):
+        stack = load_generation_stack(SampleConfig(
+            model_path=cfg.model_path, iternum=cfg.iternum,
+            resolution=cfg.resolution, mesh=cfg.mesh))
+    writer = (MetricWriter(cfg.logdir, use_tensorboard=False)
+              if cfg.logdir else None)
+    service = GenerationService(cfg, stack, writer=writer)
+    service.start()
+
+    httpd = make_server(cfg, service)
+    server_thread = threading.Thread(target=httpd.serve_forever,
+                                     name="serve-http", daemon=True)
+    server_thread.start()
+    log.info("dcr-serve listening on http://%s:%d (model %s, default bucket "
+             "%s, max_batch=%d, max_wait=%.0fms, queue_depth=%d)",
+             cfg.host, httpd.server_address[1], cfg.model_path,
+             service.default_bucket(), cfg.max_batch, cfg.max_wait_ms,
+             cfg.queue_depth)
+
+    drained = threading.Event()
+    R.install_signal_drain(lambda signum: drained.set())
+    drained.wait()
+
+    # drain: stop admission -> finish backlog -> flush in-flight responses
+    log.warning("drain: admission stopped; finishing %d queued request(s)",
+                service.queue.depth())
+    service.begin_drain()
+    if not service.join_drained(timeout=cfg.request_timeout_s):
+        R.log_event("serve_drain_incomplete", queued=service.queue.depth())
+    httpd.shutdown()
+    httpd.server_close()       # joins handler threads: responses are on the wire
+    if writer is not None:
+        writer.close()
+    log.warning("drained: exiting with code %d for the restart wrapper",
+                EXIT_PREEMPTED)
+    raise SystemExit(EXIT_PREEMPTED)
+
+
+if __name__ == "__main__":
+    main()

@@ -300,6 +300,68 @@ class SampleConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Online generation service (dcr_tpu/serve/): a resident compiled sampler
+    behind an HTTP front end with dynamic batching, an LRU prompt-embedding
+    cache, bounded-queue admission control, and SIGTERM graceful drain.
+
+    There is no reference equivalent — every generation path in somepago/DCR
+    is offline batch. The serving defaults (resolution/steps/guidance/sampler)
+    define the *default request bucket*; per-request overrides that match an
+    already-compiled bucket reuse it, anything else compiles once on first use.
+    """
+
+    model_path: str = ""
+    iternum: int = -1                      # select checkpoint_<step>; -1 = final
+    host: str = "127.0.0.1"
+    port: int = 8000
+    # default generation bucket (per-request overrides allowed)
+    resolution: int = 256
+    num_inference_steps: int = 50
+    guidance_scale: float = 7.5
+    sampler: str = "dpm++"                 # "ddim" | "dpm++" | "ddpm"
+    rand_noise_lam: float = 0.0            # inference-time mitigation (Newpipe)
+    # batching: every batch is padded to exactly max_batch requests — ONE
+    # compiled program per bucket, and (with per-request PRNG keys) results
+    # that are bit-independent of batch composition. A partial batch is
+    # flushed once its oldest request has waited max_wait_ms.
+    max_batch: int = 8
+    max_wait_ms: float = 50.0
+    # admission control: pending requests beyond this are rejected with a
+    # typed overload error (HTTP 503) instead of growing latency unboundedly
+    queue_depth: int = 64
+    cache_entries: int = 1024              # LRU prompt-embedding cache capacity
+    # resident compiled-sampler budget: per-request bucket overrides beyond
+    # this many DISTINCT (resolution, steps, guidance, sampler, λ) tuples are
+    # rejected with a typed 503 — compiled programs are never evicted, so an
+    # unbounded registry would let clients grow memory without limit
+    max_compiled_buckets: int = 8
+    request_timeout_s: float = 600.0       # per-request wait bound in the handler
+    # wedged-sampler watchdog: a single batch step exceeding this trips the
+    # coordination hang path (stack dump + exit 89) instead of hanging the
+    # port forever. 0 = disabled.
+    hang_timeout_s: float = 0.0
+    logdir: str = ""                       # MetricWriter sink ("" = off)
+    seed: int = 42                         # folds into per-request keys
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+
+
+def validate_serve_config(cfg: ServeConfig) -> None:
+    if cfg.sampler not in ("ddim", "dpm++", "ddpm"):
+        raise ValueError("serve sampler must be 'ddim', 'dpm++' or 'ddpm'")
+    if cfg.max_batch < 1:
+        raise ValueError("serve max_batch must be >= 1")
+    if cfg.queue_depth < 1:
+        raise ValueError("serve queue_depth must be >= 1")
+    if cfg.max_wait_ms < 0:
+        raise ValueError("serve max_wait_ms must be >= 0")
+    if cfg.cache_entries < 0:
+        raise ValueError("serve cache_entries must be >= 0")
+    if cfg.max_compiled_buckets < 1:
+        raise ValueError("serve max_compiled_buckets must be >= 1")
+
+
+@dataclass
 class EvalConfig:
     """Replication metrics (reference diff_retrieval.py:124-182)."""
 

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import json
 import logging
+import threading
 import time
 from collections import defaultdict, deque
 from pathlib import Path
@@ -90,6 +91,35 @@ class MetricWriter:
             self._tb.close()
         if self._wandb:
             self._wandb.finish()
+
+
+class LatencyTracker:
+    """Thread-safe sliding-window latency reservoir with percentile snapshots.
+
+    Serving telemetry (dcr_tpu/serve/) reports p50/p99 over the last ``window``
+    observations — a bounded deque, so a long-lived server never grows memory
+    with request count. Averages would hide tail latency, which is the number
+    an overloaded service degrades first.
+    """
+
+    def __init__(self, window: int = 1024):
+        self._values: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._values.append(float(seconds))
+            self.count += 1
+
+    def percentiles(self, qs: tuple = (50, 99)) -> dict[str, float]:
+        """{"p50": secs, "p99": secs, ...} over the window (0.0 when empty)."""
+        with self._lock:
+            vals = list(self._values)
+        if not vals:
+            return {f"p{q}": 0.0 for q in qs}
+        arr = np.asarray(vals)
+        return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
 
 
 class SmoothedValue:

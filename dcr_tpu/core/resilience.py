@@ -232,6 +232,41 @@ def stage(name: str, deadline: float = 0.0) -> Iterator[Deadline]:
 
 
 # ---------------------------------------------------------------------------
+# Graceful-drain signal hook
+# ---------------------------------------------------------------------------
+
+def install_signal_drain(callback: Callable[[int], None],
+                         signals: Optional[Sequence[int]] = None) -> None:
+    """Install a one-shot graceful-drain handler for SIGTERM/SIGINT.
+
+    The FIRST signal invokes ``callback(signum)`` (exactly once) and restores
+    the default disposition, so a SECOND signal kills the process immediately —
+    the escape hatch when the drain itself wedges (e.g. a compile in flight).
+    Same two-signal contract as the Trainer's preemption handler; this is the
+    reusable form for long-lived services (dcr-serve) whose drain is "stop
+    admission, finish in-flight work, exit EXIT_PREEMPTED".
+
+    ``callback`` runs in signal-handler context: it should only set flags /
+    events and return; the heavy drain work belongs on a normal thread.
+    """
+    import signal as _signal
+
+    sigs = tuple(signals or (_signal.SIGTERM, _signal.SIGINT))
+    fired = threading.Event()
+
+    def handler(signum, frame):
+        for s in sigs:
+            _signal.signal(s, _signal.SIG_DFL)
+        if not fired.is_set():
+            fired.set()
+            log_event("drain_signal", signum=signum)
+            callback(signum)
+
+    for s in sigs:
+        _signal.signal(s, handler)
+
+
+# ---------------------------------------------------------------------------
 # Quarantine manifest
 # ---------------------------------------------------------------------------
 

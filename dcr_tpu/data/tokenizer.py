@@ -42,6 +42,22 @@ class TokenizerBase:
     def decode(self, ids: Sequence[int]) -> str:
         raise NotImplementedError
 
+    def _fingerprint_fields(self) -> dict:
+        """Everything that determines the text -> ids mapping; subclasses add
+        their vocab content. Must be JSON-serializable and order-stable."""
+        return {"class": type(self).__name__, "vocab_size": self.vocab_size,
+                "model_max_length": self.model_max_length,
+                "bos": self.bos_token_id, "eos": self.eos_token_id,
+                "pad": self.pad_token_id}
+
+    def fingerprint(self) -> str:
+        """Stable hex id of this tokenizer's text->ids mapping. Two tokenizers
+        with the same fingerprint produce identical ids for identical text —
+        the cache-key component the serve embedding cache (dcr_tpu/serve/)
+        needs so a checkpoint swap can never serve stale embeddings."""
+        payload = json.dumps(self._fingerprint_fields(), sort_keys=True)
+        return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
     def __call__(self, texts: str | Sequence[str],
                  max_length: int | None = None) -> np.ndarray:
         """Tokenize with truncation + pad-to-max-length (reference
@@ -122,6 +138,16 @@ class ClipBPETokenizer(TokenizerBase):
         self.eos_token_id = self.encoder.get("<|endoftext|>", self.vocab_size - 1)
         self.pad_token_id = self.eos_token_id  # CLIP pads with EOT
         self._bpe_cache: dict[str, str] = {}
+
+    def _fingerprint_fields(self) -> dict:
+        d = super()._fingerprint_fields()
+        h = hashlib.sha256()
+        for tok, idx in sorted(self.encoder.items(), key=lambda kv: kv[1]):
+            h.update(f"{tok}\x00{idx}\x01".encode())
+        for (a, b), rank in sorted(self.bpe_ranks.items(), key=lambda kv: kv[1]):
+            h.update(f"{a}\x00{b}\x00{rank}\x01".encode())
+        d["vocab_sha"] = h.hexdigest()
+        return d
 
     def _bpe(self, token: str) -> str:
         if token in self._bpe_cache:

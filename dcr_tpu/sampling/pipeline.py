@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import logging
 from pathlib import Path
-from typing import Optional, Sequence
+from typing import Any, NamedTuple, Optional, Sequence
 
 import jax
 import numpy as np
@@ -143,6 +143,49 @@ def resolve_checkpoint(cfg: SampleConfig) -> Path:
     return cand
 
 
+class GenerationStack(NamedTuple):
+    """Everything a generation path needs, loaded once: static modules, mesh-placed
+    params, the model config, the tokenizer the checkpoint shipped with, and the
+    device mesh. Shared by the bulk pipeline (:func:`generate`) and the online
+    serving worker (dcr_tpu/serve/worker.py) so the two load paths cannot drift."""
+
+    models: DiffusionModels
+    params: dict
+    model_cfg: ModelConfig
+    tokenizer: TokenizerBase
+    mesh: Any
+
+
+def load_generation_stack(cfg: SampleConfig, *,
+                          mesh=None,
+                          tokenizer: Optional[TokenizerBase] = None,
+                          models=None, params=None) -> GenerationStack:
+    """checkpoint dir -> :class:`GenerationStack`, params placed on the mesh.
+
+    ``models``/``params`` may be passed pre-built (tests, in-process benches);
+    then only tokenizer resolution and mesh placement happen here. Placement
+    rules match training: tensor-axis meshes shard the big matmul weights
+    Megatron-style, fsdp axes shard by largest-divisible-dim, anything else
+    replicates — so a model too big for one chip's HBM still loads without
+    code changes.
+    """
+    mesh = mesh if mesh is not None else pmesh.make_mesh(cfg.mesh)
+    if models is None:
+        ckpt = resolve_checkpoint(cfg)
+        models, params, model_cfg = load_checkpoint_models(ckpt, mesh=mesh)
+    else:
+        model_cfg = models.unet.config
+    tokenizer = tokenizer or load_tokenizer(
+        cfg.model_path or None,
+        vocab_size=models.text_encoder.config.text_vocab_size,
+        model_max_length=models.text_encoder.config.text_max_length)
+    tensor_parallel = mesh.shape.get(pmesh.TENSOR_AXIS, 1) > 1
+    params = jax.device_put(
+        params, params_sharding(mesh, params, tensor_parallel=tensor_parallel))
+    return GenerationStack(models=models, params=params, model_cfg=model_cfg,
+                           tokenizer=tokenizer, mesh=mesh)
+
+
 def generate(cfg: SampleConfig, *, modelstyle: str,
              tokenizer: Optional[TokenizerBase] = None,
              caption_json: Optional[str] = None,
@@ -150,14 +193,10 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
              models=None, params=None) -> Path:
     """Run bulk generation; returns the savepath containing generations/."""
     dist.initialize()
-    mesh = pmesh.make_mesh(cfg.mesh)
-    if models is None:
-        ckpt = resolve_checkpoint(cfg)
-        models, params, _ = load_checkpoint_models(ckpt, mesh=mesh)
-    tokenizer = tokenizer or load_tokenizer(
-        cfg.model_path or None,
-        vocab_size=models.text_encoder.config.text_vocab_size,
-        model_max_length=models.text_encoder.config.text_max_length)
+    stack = load_generation_stack(cfg, tokenizer=tokenizer,
+                                  models=models, params=params)
+    models, params = stack.models, stack.params
+    tokenizer, mesh = stack.tokenizer, stack.mesh
 
     if prompts is None:
         prompts = build_prompt_list(
@@ -170,14 +209,6 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
     if dist.is_primary():
         gen_dir.mkdir(parents=True, exist_ok=True)
         save_prompts(prompts, savepath)
-
-    # place params on the mesh: tensor-axis meshes shard the big matmul
-    # weights Megatron-style (same rules as training), fsdp axes shard by
-    # largest-divisible-dim, anything else replicates — so a model too big
-    # for one chip's HBM samples across chips without code changes
-    tensor_parallel = mesh.shape.get(pmesh.TENSOR_AXIS, 1) > 1
-    params = jax.device_put(
-        params, params_sharding(mesh, params, tensor_parallel=tensor_parallel))
 
     sampler = make_sampler(cfg, models, mesh)
     uncond_ids = tokenizer([""])[0]

@@ -64,6 +64,25 @@ def sampler_grid(sampler: str, sched, num_inference_steps: int):
     return ts, prev_ts, num_inference_steps < 15
 
 
+def scheduler_step(sampler: str, sched, pred: jax.Array, x: jax.Array,
+                   t, prev_t, dpm_state, *, force_first_order=False,
+                   noise_key: Optional[jax.Array] = None):
+    """One denoising update ``x_t -> x_{prev_t}`` for a sampler name —
+    the single dispatch both the bulk pipeline (:func:`make_sampler`) and the
+    serving worker (dcr_tpu/serve/worker.py) call, so a scheduler-parity fix
+    lands in every generation path at once. Returns ``(x_new, dpm_state)``;
+    ``noise_key`` is required only for the ancestral ``ddpm`` sampler."""
+    if sampler == "ddim":
+        return S.ddim_step(sched, pred, x, t, prev_t), dpm_state
+    if sampler == "dpm++":
+        return S.dpmpp_2m_step(sched, pred, x, t, prev_t, dpm_state,
+                               force_first_order=force_first_order)
+    if sampler == "ddpm":
+        assert noise_key is not None, "ddpm needs a per-step noise key"
+        return S.ddpm_step(sched, pred, x, t, prev_t, noise_key), dpm_state
+    raise ValueError(f"unknown sampler {sampler!r}")
+
+
 def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     """Build the jitted sampler: (params, input_ids, uncond_ids, key) -> images.
 
@@ -115,20 +134,12 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
                                      jnp.concatenate([x, x], axis=0), tb, ctx)
             pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
             pred = pred_uncond + guidance * (pred_cond - pred_uncond)
-            if cfg.sampler == "ddim":
-                x_new = S.ddim_step(sched, pred, x, t, prev_t)
-                dpm_new = dpm_state
-            elif cfg.sampler == "dpm++":
-                force1 = jnp.logical_and(lower_order_final,
-                                         step_idx == len(ts) - 1)
-                x_new, dpm_new = S.dpmpp_2m_step(sched, pred, x, t, prev_t,
-                                                 dpm_state, force_first_order=force1)
-            elif cfg.sampler == "ddpm":
-                x_new = S.ddpm_step(sched, pred, x, t, prev_t,
-                                    jax.random.fold_in(ks, step_idx))
-                dpm_new = dpm_state
-            else:
-                raise ValueError(f"unknown sampler {cfg.sampler!r}")
+            force1 = jnp.logical_and(lower_order_final,
+                                     step_idx == len(ts) - 1)
+            x_new, dpm_new = scheduler_step(
+                cfg.sampler, sched, pred, x, t, prev_t, dpm_state,
+                force_first_order=force1,
+                noise_key=jax.random.fold_in(ks, step_idx))
             return (x_new, dpm_new), ()
 
         init = (x, S.dpm_init_state(x.shape))

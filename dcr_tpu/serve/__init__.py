@@ -1,0 +1,35 @@
+"""dcr-serve: the online generation service.
+
+Layer map (all single-host, single-device-owner):
+
+- :mod:`dcr_tpu.serve.queue` — bounded admission queue, typed overload/drain
+  rejections, bucket-tagged requests;
+- :mod:`dcr_tpu.serve.batcher` — deadline-aware dynamic batching (flush on
+  full bucket or max-wait, immediate during drain);
+- :mod:`dcr_tpu.serve.cache` — LRU prompt-embedding cache keyed on
+  (tokenizer fingerprint, prompt, mitigation params);
+- :mod:`dcr_tpu.serve.worker` — the resident core: per-bucket compiled
+  samplers at a fixed padded batch shape, per-request PRNG keys, watchdog;
+- :mod:`dcr_tpu.serve.server` — stdlib HTTP front end
+  (POST /generate, GET /healthz, GET /metrics).
+
+Entry point: ``dcr-serve`` (:mod:`dcr_tpu.cli.serve`). SIGTERM stops
+admission, finishes in-flight batches, and exits with
+:data:`dcr_tpu.core.coordination.EXIT_PREEMPTED` (83).
+"""
+
+from dcr_tpu.serve.batcher import Batcher, should_flush
+from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
+from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
+                                 DrainingError, GenBucket,
+                                 InvalidRequestError, QueueFullError, Request,
+                                 RequestQueue)
+from dcr_tpu.serve.worker import (GenerationService, make_batch_sampler,
+                                  validate_bucket)
+
+__all__ = [
+    "AdmissionError", "Batcher", "BucketLimitError", "DrainingError",
+    "EmbeddingCache", "GenBucket", "GenerationService", "InvalidRequestError",
+    "QueueFullError", "Request", "RequestQueue", "embedding_key",
+    "make_batch_sampler", "mitigation_tag", "should_flush", "validate_bucket",
+]

@@ -1,0 +1,164 @@
+"""stdlib HTTP front end for the generation service.
+
+Endpoints (JSON in/out):
+
+- ``POST /generate`` — body ``{"prompt": str, "seed"?: int, "steps"?: int,
+  "guidance"?: float, "sampler"?: str, "rand_noise_lam"?: float}``. Replies
+  200 with ``{"id", "image_png_b64", "width", "height", "cache_hit",
+  "latency_ms"}``; 400 on malformed input or invalid bucket parameters
+  (validated BEFORE any compile); 503 with ``{"error":
+  "overloaded"|"draining"|"bucket_limit"}`` on typed admission rejection;
+  504 when the request exceeds the configured wait bound.
+- ``GET /healthz`` — 200 ``{"status": "ok"|"draining"}`` (load balancers pull
+  a draining replica out of rotation before its port closes).
+- ``GET /metrics`` — the :meth:`GenerationService.status` document: queue
+  depth, batch occupancy, cache hit rate, p50/p99 latency.
+
+``http.server`` is deliberate: zero new dependencies, and the threading
+server's one-thread-per-connection model matches the workload — handler
+threads only tokenize and block on a Future while the single worker thread
+owns the device. ``block_on_close`` + non-daemon handler threads give the
+drain guarantee: ``server_close()`` returns only after every in-flight
+response has been written.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import logging
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from dcr_tpu.core.config import ServeConfig
+from dcr_tpu.serve.queue import (BucketLimitError, DrainingError, GenBucket,
+                                 InvalidRequestError, QueueFullError)
+from dcr_tpu.serve.worker import GenerationService
+
+log = logging.getLogger("dcr_tpu")
+
+_ALLOWED_OVERRIDES = ("seed", "steps", "guidance", "sampler", "rand_noise_lam",
+                      "resolution")
+
+
+def png_bytes(image: np.ndarray) -> bytes:
+    """float32 [H, W, 3] in [0, 1] -> PNG (runs on handler threads, keeping
+    the worker thread on device work only)."""
+    from PIL import Image
+
+    arr = (np.asarray(image) * 255.0).round().astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def request_bucket(service: GenerationService, body: dict) -> GenBucket:
+    """Default bucket + per-request overrides. Unknown keys are a 400-class
+    error (loud contract, not silent acceptance)."""
+    unknown = set(body) - {"prompt"} - set(_ALLOWED_OVERRIDES)
+    if unknown:
+        raise ValueError(f"unknown request fields {sorted(unknown)!r}")
+    d = service.default_bucket()
+    return GenBucket(
+        resolution=int(body.get("resolution", d.resolution)),
+        steps=int(body.get("steps", d.steps)),
+        guidance=float(body.get("guidance", d.guidance)),
+        sampler=str(body.get("sampler", d.sampler)),
+        rand_noise_lam=float(body.get("rand_noise_lam", d.rand_noise_lam)),
+    )
+
+
+class ServeHandler(BaseHTTPRequestHandler):
+    service: GenerationService      # set by make_server on the subclass
+    cfg: ServeConfig
+    protocol_version = "HTTP/1.1"
+    # socket timeout for reads BETWEEN requests on a keep-alive connection
+    # (and for slow request reads). Without it, an idle connection-pool
+    # socket parks its handler thread in rfile.readline() forever, and the
+    # drain's server_close() — which joins handler threads — never returns,
+    # so the exit-83 contract would silently never fire.
+    timeout = 15
+
+    def log_message(self, fmt, *args):  # route access logs through logging
+        log.debug("serve http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        data = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:
+        if self.path == "/healthz":
+            status = "draining" if self.service.draining else "ok"
+            self._reply(200, {"status": status})
+        elif self.path == "/metrics":
+            self._reply(200, self.service.status())
+        else:
+            self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+
+    def do_POST(self) -> None:
+        if self.path != "/generate":
+            self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+            prompt = body["prompt"]
+            if not isinstance(prompt, str) or not prompt.strip():
+                raise ValueError("'prompt' must be a non-empty string")
+            bucket = request_bucket(self.service, body)
+            seed = int(body.get("seed", 0))
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        try:
+            req = self.service.submit(prompt, seed=seed, bucket=bucket)
+        except InvalidRequestError as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        except QueueFullError:
+            self._reply(503, {"error": "overloaded"})
+            return
+        except BucketLimitError as e:
+            self._reply(503, {"error": "bucket_limit", "detail": str(e)})
+            return
+        except DrainingError:
+            self._reply(503, {"error": "draining"})
+            return
+        try:
+            image = req.future.result(timeout=self.cfg.request_timeout_s)
+        except FutureTimeout:
+            self._reply(504, {"error": "request timed out in queue/batch"})
+            return
+        except Exception as e:
+            self._reply(500, {"error": f"generation failed: {e!r}"})
+            return
+        self._reply(200, {
+            "id": req.id,
+            "image_png_b64": base64.b64encode(png_bytes(image)).decode(),
+            "width": int(image.shape[1]),
+            "height": int(image.shape[0]),
+            "cache_hit": bool(req.cache_hit),
+            "latency_ms": None,  # client-side wall time is the honest number
+        })
+
+
+def make_server(cfg: ServeConfig,
+                service: GenerationService) -> ThreadingHTTPServer:
+    """ThreadingHTTPServer wired to the service. Handler threads are
+    non-daemon and joined by ``server_close()`` (block_on_close), so the
+    drain sequence can guarantee every accepted request gets its response."""
+    handler = type("BoundServeHandler", (ServeHandler,),
+                   {"service": service, "cfg": cfg})
+    httpd = ThreadingHTTPServer((cfg.host, cfg.port), handler)
+    httpd.daemon_threads = False
+    httpd.block_on_close = True
+    return httpd

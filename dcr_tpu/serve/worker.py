@@ -1,0 +1,465 @@
+"""Resident generation worker: compiled-sampler registry + batch execution.
+
+The economics of online diffusion serving (DiffusionPipe, arXiv:2405.01248;
+PFDiff, arXiv:2408.08822) are all amortization: compilation is paid once per
+bucket, the text tower once per unique prompt, and the UNet scan — the real
+work — runs over dynamically formed batches. This module is that resident
+core, HTTP-free so benches and tests drive it in-process:
+
+- one jitted sampler per :class:`~dcr_tpu.serve.queue.GenBucket`, compiled at
+  a FIXED batch shape (``max_batch``, padded). One shape means one program
+  AND bit-reproducible results: XLA fuses differently per batch size, so
+  variable shapes would make an image depend on who it shared a batch with;
+- per-request PRNG keys: every random draw for request i derives from
+  ``fold_in(root, seed_i)`` and is generated per-row (vmap), so a prompt
+  sampled alone is bit-identical to the same prompt inside a mixed batch;
+- the prompt-embedding LRU (:mod:`dcr_tpu.serve.cache`) skips the CLIP text
+  tower for repeated prompts;
+- a wedged device step trips the coordination hang path (stack dump + exit
+  89) via :func:`dcr_tpu.core.resilience.watchdog` instead of hanging the
+  port until the scheduler notices.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.core.config import ServeConfig
+from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
+from dcr_tpu.models import schedulers as S
+from dcr_tpu.models.vae import vae_scale_factor
+from dcr_tpu.sampling.pipeline import GenerationStack
+from dcr_tpu.sampling.sampler import sampler_grid, scheduler_step
+from dcr_tpu.serve.batcher import Batcher
+from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
+from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
+                                 DrainingError, GenBucket,
+                                 InvalidRequestError, Request, RequestQueue)
+
+log = logging.getLogger("dcr_tpu")
+
+SAMPLERS = ("ddim", "dpm++", "ddpm")
+MAX_STEPS = 1000        # more denoising steps than train timesteps is nonsense
+MAX_RESOLUTION = 4096
+
+
+def validate_bucket(bucket: GenBucket, *, vae_scale: int) -> None:
+    """Reject client-controlled bucket parameters BEFORE they reach jit:
+    an invalid value must be a typed 400-class error, not a cryptic compile
+    failure (500) — and never a compiled-and-cached degenerate program."""
+    if bucket.sampler not in SAMPLERS:
+        raise InvalidRequestError(
+            f"sampler must be one of {SAMPLERS}, got {bucket.sampler!r}")
+    if not 1 <= bucket.steps <= MAX_STEPS:
+        raise InvalidRequestError(
+            f"steps must be in [1, {MAX_STEPS}], got {bucket.steps}")
+    if not (vae_scale <= bucket.resolution <= MAX_RESOLUTION
+            and bucket.resolution % vae_scale == 0):
+        raise InvalidRequestError(
+            f"resolution must be a multiple of {vae_scale} in "
+            f"[{vae_scale}, {MAX_RESOLUTION}], got {bucket.resolution}")
+    if not 0.0 <= bucket.guidance <= 100.0:
+        raise InvalidRequestError(
+            f"guidance must be in [0, 100], got {bucket.guidance}")
+    if not 0.0 <= bucket.rand_noise_lam <= 10.0:
+        raise InvalidRequestError(
+            f"rand_noise_lam must be in [0, 10], got {bucket.rand_noise_lam}")
+
+
+def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
+                       batch_size: int):
+    """Jitted ``(params, cond, uncond, seeds) -> images`` for one bucket.
+
+    cond/uncond: [B, L, D] prompt embeddings (already encoded/cached);
+    seeds: [B] uint32 per-request seeds. Every stochastic draw for row i uses
+    only ``fold_in(root_key(root_seed), seeds[i])``-derived keys, generated
+    per-row, so row i's image is a pure function of (params, cond[i],
+    seeds[i]) — batch composition cannot perturb it.
+    """
+    sched = models.schedule
+    ts, prev_ts, lower_order_final = sampler_grid(bucket.sampler, sched,
+                                                  bucket.steps)
+    latent_size = bucket.resolution // vae_scale_factor(models.vae.config)
+    latent_ch = models.vae.config.vae_latent_channels
+    scaling = models.vae.config.vae_scaling_factor
+    guidance = bucket.guidance
+    lam = bucket.rand_noise_lam
+
+    def sample_fn(params, cond, uncond, seeds):
+        if cond.shape[0] != batch_size:  # dcr-lint: disable=DCR007 — branch on a STATIC shape, not a traced value: this is the trace-time guard that RAISES before a second batch shape can compile (the exact recompile hazard DCR007 polices)
+            # trace-time guard for the load-bearing fixed-shape invariant:
+            # a caller skipping execute()'s padding would otherwise silently
+            # compile a second program and break batch-composition
+            # bit-reproducibility (XLA fuses differently per shape)
+            raise ValueError(
+                f"batch sampler for {bucket} is compiled at batch="
+                f"{batch_size}; got {cond.shape[0]} rows — pad the batch")
+        root = rngmod.root_key(root_seed)
+        keys = jax.vmap(lambda s: jax.random.fold_in(root, s))(seeds)
+        if lam > 0.0:
+            # Newpipe mitigation noise, per-request: fresh noise even for a
+            # cache-hit embedding, independent of the rest of the batch
+            def noise_pair(c, u, k):
+                k1, k2 = jax.random.split(rngmod.stream_key(k, "emb_noise"))
+                return (c + lam * jax.random.normal(k1, c.shape, c.dtype),
+                        u + lam * jax.random.normal(k2, u.shape, u.dtype))
+            cond, uncond = jax.vmap(noise_pair)(cond, uncond, keys)
+        ctx = jnp.concatenate([uncond, cond], axis=0)      # [2B, L, D]
+
+        x = jax.vmap(lambda k: jax.random.normal(
+            rngmod.stream_key(k, "init"),
+            (latent_size, latent_size, latent_ch)))(keys)  # [B, h, w, c]
+        step_keys = jax.vmap(lambda k: rngmod.stream_key(k, "steps"))(keys)
+
+        def denoise(carry, step_idx):
+            x, dpm_state = carry
+            t = ts[step_idx]
+            prev_t = prev_ts[step_idx]
+            bsz = x.shape[0]
+            tb = jnp.full((2 * bsz,), t, jnp.int32)
+            pred = models.unet.apply({"params": params["unet"]},
+                                     jnp.concatenate([x, x], axis=0), tb, ctx)
+            pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
+            pred = pred_uncond + guidance * (pred_cond - pred_uncond)
+            if bucket.sampler == "ddpm":
+                # per-row keys via vmap: the ancestral noise of request i
+                # must not depend on batch position or neighbors (the bulk
+                # pipeline draws ONE batch-shaped noise per step instead)
+                x_new = jax.vmap(
+                    lambda p_row, x_row, k_row: scheduler_step(
+                        bucket.sampler, sched, p_row, x_row, t, prev_t, None,
+                        noise_key=jax.random.fold_in(k_row, step_idx))[0])(
+                    pred, x, step_keys)
+                dpm_new = dpm_state
+            else:
+                force1 = jnp.logical_and(lower_order_final,
+                                         step_idx == len(ts) - 1)
+                x_new, dpm_new = scheduler_step(
+                    bucket.sampler, sched, pred, x, t, prev_t, dpm_state,
+                    force_first_order=force1)
+            return (x_new, dpm_new), ()
+
+        init = (x, S.dpm_init_state(x.shape))
+        (x, _), _ = jax.lax.scan(denoise, init, jnp.arange(len(ts)))
+        images = models.vae.apply({"params": params["vae"]}, x / scaling,
+                                  method=models.vae.decode)
+        return jnp.clip(images * 0.5 + 0.5, 0.0, 1.0)
+
+    return jax.jit(sample_fn)
+
+
+class ServeMetrics:
+    """Counters + latency reservoir behind one lock; snapshots feed both the
+    /metrics endpoint and the MetricWriter scalars."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.rejected_overload = 0
+        self.rejected_draining = 0
+        self.rejected_invalid = 0
+        self.rejected_bucket_limit = 0
+        self.completed_total = 0
+        self.failed_total = 0
+        self.batches_total = 0
+        self.occupancy_last = 0.0
+        self.occupancy_max = 0.0
+        self._occupancy_sum = 0.0
+        self.latency = LatencyTracker()
+
+    def note_submitted(self) -> None:
+        with self._lock:
+            self.requests_total += 1
+
+    def note_rejected(self, error: AdmissionError) -> None:
+        with self._lock:
+            if isinstance(error, DrainingError):
+                self.rejected_draining += 1
+            elif isinstance(error, InvalidRequestError):
+                self.rejected_invalid += 1
+            elif isinstance(error, BucketLimitError):
+                self.rejected_bucket_limit += 1
+            else:
+                self.rejected_overload += 1
+
+    def note_batch(self, n_real: int, batch_size: int, ok: bool) -> None:
+        occ = n_real / max(1, batch_size)
+        with self._lock:
+            self.batches_total += 1
+            self.occupancy_last = occ
+            self.occupancy_max = max(self.occupancy_max, occ)
+            self._occupancy_sum += occ
+            if ok:
+                self.completed_total += n_real
+            else:
+                self.failed_total += n_real
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            batches = self.batches_total
+            d = {
+                "requests_total": self.requests_total,
+                "rejected_overload": self.rejected_overload,
+                "rejected_draining": self.rejected_draining,
+                "rejected_invalid": self.rejected_invalid,
+                "rejected_bucket_limit": self.rejected_bucket_limit,
+                "completed_total": self.completed_total,
+                "failed_total": self.failed_total,
+                "batches_total": batches,
+                "batch_occupancy_last": self.occupancy_last,
+                "batch_occupancy_max": self.occupancy_max,
+                "batch_occupancy_avg": (self._occupancy_sum / batches
+                                        if batches else 0.0),
+            }
+        pct = self.latency.percentiles((50, 99))
+        d["latency_ms"] = {k: round(v * 1000.0, 3) for k, v in pct.items()}
+        return d
+
+
+class GenerationService:
+    """The resident serving core: queue + batcher + cache + compiled samplers.
+
+    HTTP-free by design — :mod:`dcr_tpu.serve.server` fronts it for network
+    traffic, while benches and tests call :meth:`submit`/:meth:`execute`
+    directly. One worker thread drains the queue; handler threads only
+    tokenize-and-wait.
+    """
+
+    def __init__(self, cfg: ServeConfig, stack: GenerationStack, *,
+                 writer: Optional[MetricWriter] = None):
+        self.cfg = cfg
+        self.stack = stack
+        self.queue = RequestQueue(cfg.queue_depth)
+        self.batcher = Batcher(cfg.max_batch, cfg.max_wait_ms / 1000.0)
+        self.cache = EmbeddingCache(cfg.cache_entries)
+        self.metrics = ServeMetrics()
+        self._writer = writer
+        self._samplers: dict[GenBucket, object] = {}
+        # buckets counted against max_compiled_buckets at ADMISSION time, not
+        # first compile — otherwise a burst of novel buckets all passes the
+        # budget check before the worker compiles any of them
+        self._admitted_buckets: set[GenBucket] = set()
+        self._samplers_lock = threading.Lock()
+        self._vae_scale = vae_scale_factor(stack.models.vae.config)
+        # a misconfigured default bucket must fail at STARTUP, not boot a
+        # healthy-looking replica that 400s every default request
+        validate_bucket(self.default_bucket(), vae_scale=self._vae_scale)
+        models = stack.models
+        self._encode = jax.jit(
+            lambda text_params, ids: models.text_encoder.apply(
+                {"params": text_params}, ids).last_hidden_state)
+        self._tok_fp = stack.tokenizer.fingerprint()
+        self._uncond: Optional[np.ndarray] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request plumbing ----------------------------------------------------
+
+    def default_bucket(self) -> GenBucket:
+        c = self.cfg
+        return GenBucket(resolution=c.resolution, steps=c.num_inference_steps,
+                         guidance=c.guidance_scale, sampler=c.sampler,
+                         rand_noise_lam=c.rand_noise_lam)
+
+    def submit(self, prompt: str, *, seed: int = 0,
+               bucket: Optional[GenBucket] = None) -> Request:
+        """Admit a request. Typed AdmissionError on every rejection path:
+        InvalidRequestError (bad bucket params), BucketLimitError (would
+        compile past the resident-program budget), QueueFullError (overload),
+        DrainingError (SIGTERM seen)."""
+        bucket = bucket or self.default_bucket()
+        try:
+            validate_bucket(bucket, vae_scale=self._vae_scale)
+            with self._samplers_lock:
+                if (bucket not in self._admitted_buckets
+                        and len(self._admitted_buckets)
+                        >= self.cfg.max_compiled_buckets):
+                    raise BucketLimitError(
+                        f"bucket {bucket} would exceed the resident compiled-"
+                        f"sampler budget ({self.cfg.max_compiled_buckets}); "
+                        "use an already-served parameter combination")
+                self._admitted_buckets.add(bucket)
+            req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
+                          bucket=bucket)
+            self.queue.submit(req)
+        except AdmissionError as e:
+            self.metrics.note_rejected(e)
+            raise
+        self.metrics.note_submitted()
+        return req
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serve-worker")
+        self._thread.start()
+
+    def begin_drain(self) -> None:
+        """Stop admission; the worker keeps going until the queue is empty."""
+        self.queue.close()
+        self._stop.set()
+
+    def join_drained(self, timeout: Optional[float] = None) -> bool:
+        """Wait for the worker to finish the backlog; True when fully drained."""
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive() and self.queue.empty()
+
+    def stop(self, timeout: Optional[float] = None) -> bool:
+        self.begin_drain()
+        return self.join_drained(timeout)
+
+    @property
+    def draining(self) -> bool:
+        return self.queue.closed
+
+    # -- execution -----------------------------------------------------------
+
+    def _sampler_for(self, bucket: GenBucket):
+        with self._samplers_lock:
+            fn = self._samplers.get(bucket)
+            if fn is None:
+                log.info("serve: compiling sampler for bucket %s at batch=%d",
+                         bucket, self.cfg.max_batch)
+                fn = make_batch_sampler(bucket, self.stack.models,
+                                        self.cfg.seed, self.cfg.max_batch)
+                self._samplers[bucket] = fn
+            return fn
+
+    def _uncond_embedding(self) -> np.ndarray:
+        if self._uncond is None:
+            ids = self.stack.tokenizer([""])
+            self._uncond = np.asarray(
+                self._encode(self.stack.params["text"], ids))[0]
+        return self._uncond
+
+    def _cond_embedding(self, req: Request, mitigation: str) -> np.ndarray:
+        key = embedding_key(self._tok_fp, req.prompt, mitigation)
+        emb = self.cache.get(key)
+        req.cache_hit = emb is not None
+        if emb is None:
+            ids = self.stack.tokenizer([req.prompt])
+            emb = np.asarray(self._encode(self.stack.params["text"], ids))[0]
+            self.cache.put(key, emb)
+        return emb
+
+    def execute(self, requests: list[Request]) -> np.ndarray:
+        """Run one bucket-coherent batch; returns float32 [n, H, W, 3].
+
+        Pads to the fixed ``max_batch`` shape with uncond-embedding rows
+        (results discarded), so every batch of a bucket hits the same
+        compiled program regardless of occupancy.
+        """
+        if not requests:
+            return np.zeros((0,), np.float32)
+        bucket = requests[0].bucket
+        assert all(r.bucket == bucket for r in requests), \
+            "execute() requires a bucket-coherent batch"
+        n = len(requests)
+        pad = self.cfg.max_batch - n
+        if pad < 0:
+            raise ValueError(f"batch of {n} exceeds max_batch={self.cfg.max_batch}")
+        fn = self._sampler_for(bucket)
+        mitigation = mitigation_tag(bucket)
+        uncond_row = self._uncond_embedding()
+        cond = np.stack([self._cond_embedding(r, mitigation) for r in requests]
+                        + [uncond_row] * pad)
+        uncond = np.stack([uncond_row] * self.cfg.max_batch)
+        seeds = np.asarray([r.seed for r in requests] + [0] * pad, np.uint32)
+        images = fn(self.stack.params, cond, uncond, seeds)
+        return np.asarray(images)[:n]
+
+    # -- the drain loop ------------------------------------------------------
+
+    def _on_hang(self) -> None:
+        from dcr_tpu.core.coordination import hang_abort
+
+        hang_abort("serve_batch",
+                   detail=f"sampler step exceeded {self.cfg.hang_timeout_s}s")
+
+    def _process(self, batch: list[Request]) -> None:
+        t0 = time.monotonic()
+        try:
+            # the watchdog turns a wedged device step into a structured
+            # post-mortem + EXIT_HANG instead of a silently dead port
+            with R.watchdog("serve:batch", self.cfg.hang_timeout_s,
+                            on_timeout=self._on_hang):
+                images = self.execute(batch)
+        except Exception as e:
+            R.log_event("serve_batch_failed", batch=len(batch),
+                        bucket=str(batch[0].bucket), error=repr(e))
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self.metrics.note_batch(len(batch), self.cfg.max_batch, ok=False)
+            return
+        now = time.monotonic()
+        for req, img in zip(batch, images):
+            self.metrics.latency.observe(now - req.enqueued_at)
+            req.future.set_result(img)
+        self.metrics.note_batch(len(batch), self.cfg.max_batch, ok=True)
+        log.info("serve: batch of %d/%d in %.3fs (queue depth %d)",
+                 len(batch), self.cfg.max_batch, now - t0, self.queue.depth())
+        if self._writer is not None:
+            try:
+                snap = self.metrics.snapshot()
+                cache = self.cache.stats()
+                self._writer.scalars(snap["batches_total"], {
+                    "serve/queue_depth": self.queue.depth(),
+                    "serve/batch_occupancy": snap["batch_occupancy_last"],
+                    "serve/cache_hit_rate": cache["hit_rate"],
+                    "serve/latency_p50_ms": snap["latency_ms"]["p50"],
+                    "serve/latency_p99_ms": snap["latency_ms"]["p99"],
+                })
+            except Exception as e:
+                # telemetry must never stop serving (a full disk under
+                # --logdir is not a generation failure) — the requests were
+                # already answered above
+                R.log_event("serve_metrics_write_failed", error=repr(e))
+                R.bump_counter("serve_metrics_write_failed")
+
+    def _run(self) -> None:
+        while True:
+            batch = self.batcher.next_batch(self.queue, stop=self._stop)
+            if batch is None:
+                break
+            try:
+                self._process(batch)
+            except Exception as e:
+                # last-resort guard: _process already converts generation
+                # failures into per-request exceptions, so anything landing
+                # here is a serving-layer bug — fail the batch's futures and
+                # keep the port alive rather than dying silently with
+                # /healthz still reporting ok
+                R.log_event("serve_worker_error", error=repr(e),
+                            batch=len(batch))
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+        log.info("serve: worker drained and stopped")
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        """The /metrics document."""
+        d = self.metrics.snapshot()
+        d["queue_depth"] = self.queue.depth()
+        d["draining"] = self.draining
+        d["cache"] = self.cache.stats()
+        with self._samplers_lock:     # worker thread mutates concurrently
+            d["compiled_buckets"] = [tuple(b) for b in self._samplers]
+        return d

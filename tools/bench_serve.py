@@ -1,0 +1,140 @@
+"""Serving-throughput bench: dynamic batching vs one-request-at-a-time.
+
+Drives the real GenerationService in-process (no HTTP overhead in the
+numbers): a sequential baseline completes each request before submitting the
+next (max_batch=1 — the offline-loop serving model dcr-serve replaces), then
+the batched run submits the same workload concurrently against max_batch=N
+dynamic batching. Compilation is paid up front for both and excluded.
+
+Writes BENCH_SERVE.json. Acceptance: batched throughput > sequential.
+
+Usage: python tools/bench_serve.py
+Env knobs: BENCH_SERVE_REQUESTS (default 32), BENCH_SERVE_BATCH (default 8),
+BENCH_SERVE_STEPS (default 4), BENCH_SERVE_RES (default 16, tiny model).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).resolve().parent.parent / "BENCH_SERVE.json"
+
+
+def _build_stack():
+    import jax
+
+    from dcr_tpu.core.config import MeshConfig, ModelConfig, TrainConfig
+    from dcr_tpu.data.tokenizer import HashTokenizer
+    from dcr_tpu.diffusion.trainer import build_models
+    from dcr_tpu.parallel import mesh as pmesh
+    from dcr_tpu.sampling.pipeline import GenerationStack
+
+    tiny = ModelConfig.tiny()
+    tcfg = TrainConfig(mixed_precision="no")
+    tcfg.model = tiny
+    models, params = build_models(tcfg, jax.random.key(0))
+    tok = HashTokenizer(vocab_size=tiny.text_vocab_size,
+                        model_max_length=tiny.text_max_length)
+    return GenerationStack(models, params, tiny, tok,
+                           pmesh.make_mesh(MeshConfig()))
+
+
+def _service(stack, *, max_batch: int, steps: int, res: int):
+    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.serve.worker import GenerationService
+
+    cfg = ServeConfig(resolution=res, num_inference_steps=steps,
+                      sampler="ddim", max_batch=max_batch, max_wait_ms=25.0,
+                      queue_depth=256, seed=0)
+    svc = GenerationService(cfg, stack)
+    svc.start()
+    return svc
+
+
+def _prompts(n: int) -> list[str]:
+    # 4 unique prompts cycled: a realistic repeat-heavy stream, so the
+    # embedding cache participates in both legs identically
+    uniq = ["a red square", "a blue circle", "a green triangle",
+            "a yellow star"]
+    return [uniq[i % len(uniq)] for i in range(n)]
+
+
+def main() -> None:
+    n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
+    steps = int(os.environ.get("BENCH_SERVE_STEPS", "4"))
+    res = int(os.environ.get("BENCH_SERVE_RES", "16"))
+
+    cache_dir = Path(__file__).resolve().parent.parent / ".jax_cache"
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    print(f"bench_serve: {n_requests} requests, max_batch={max_batch}, "
+          f"steps={steps}, res={res}, devices={len(jax.devices())}", flush=True)
+
+    stack = _build_stack()
+    prompts = _prompts(n_requests)
+    result: dict = {"requests": n_requests, "max_batch": max_batch,
+                    "steps": steps, "resolution": res, "sampler": "ddim",
+                    "model": "tiny"}
+
+    from dcr_tpu.serve.queue import Request
+
+    def warmup(svc):
+        # pay the compile outside the queue so timing AND latency telemetry
+        # (p50/p99) reflect steady-state serving only
+        svc.execute([Request(prompt="warmup", seed=0,
+                             bucket=svc.default_bucket())])
+
+    # -- sequential baseline: one request at a time, batch shape 1 ----------
+    seq = _service(stack, max_batch=1, steps=steps, res=res)
+    warmup(seq)
+    t0 = time.perf_counter()
+    for i, p in enumerate(prompts):
+        seq.submit(p, seed=i).future.result(timeout=600)
+    seq_s = time.perf_counter() - t0
+    seq.stop(timeout=60)
+    result["sequential"] = {
+        "total_s": round(seq_s, 3),
+        "requests_per_s": round(n_requests / seq_s, 3),
+        "cache": seq.cache.stats(),
+    }
+    print("sequential:", json.dumps(result["sequential"]), flush=True)
+
+    # -- batched: same workload submitted concurrently ----------------------
+    bat = _service(stack, max_batch=max_batch, steps=steps, res=res)
+    warmup(bat)
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=min(32, n_requests)) as ex:
+        futs = list(ex.map(lambda a: bat.submit(a[1], seed=a[0]).future,
+                           enumerate(prompts)))
+        for f in futs:
+            f.result(timeout=600)
+    bat_s = time.perf_counter() - t0
+    snap = bat.metrics.snapshot()
+    bat.stop(timeout=60)
+    result["batched"] = {
+        "total_s": round(bat_s, 3),
+        "requests_per_s": round(n_requests / bat_s, 3),
+        "batch_occupancy_avg": round(snap["batch_occupancy_avg"], 3),
+        "batch_occupancy_max": snap["batch_occupancy_max"],
+        "latency_ms": snap["latency_ms"],
+        "cache": bat.cache.stats(),
+    }
+    result["speedup"] = round(seq_s / bat_s, 3)
+    print("batched:", json.dumps(result["batched"]), flush=True)
+    print(f"speedup: {result['speedup']}x", flush=True)
+
+    OUT.write_text(json.dumps(result, indent=2) + "\n")
+    print(f"wrote {OUT}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
