@@ -508,6 +508,25 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
               "gflops_per_step_chip": round(flops / 1e9, 1),
               "remat": remat,
               "loss": round(float(m["loss"]), 4)}
+    # tail-aware step time: individually-synced steps through a LatencyTracker
+    # reservoir, so the BENCH trail records p50/p99 alongside the slope mean —
+    # a mean hides exactly the stragglers (recompiles, host stalls, tunnel
+    # hiccups) a perf PR needs to see. Each sample pays one sync RTT, so the
+    # percentiles are upper bounds on device step time; the unbiased mean
+    # stays `step_ms`. BENCH_TAIL_STEPS=0 disables.
+    tail_steps = _env_int("BENCH_TAIL_STEPS", 5)
+    if tail_steps > 0:
+        from dcr_tpu.core.metrics import LatencyTracker
+
+        dog.rearm()
+        tail = LatencyTracker(window=max(tail_steps, 16))
+        for _ in range(tail_steps):
+            tail.observe(run(1))
+        pct = tail.percentiles((50, 99))
+        result["step_ms_p50"] = round(pct["p50"] * 1e3, 1)
+        result["step_ms_p99"] = round(pct["p99"] * 1e3, 1)
+        result["tail_steps"] = tail_steps
+        result["tail_includes_sync_rtt"] = True
     mark("rung_done", **result)
     return result
 

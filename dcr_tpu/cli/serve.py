@@ -41,6 +41,7 @@ def main(argv=None) -> None:
 
     from dcr_tpu.core import dist
     from dcr_tpu.core import resilience as R
+    from dcr_tpu.core import tracing
     from dcr_tpu.core.coordination import EXIT_PREEMPTED
     from dcr_tpu.core.metrics import MetricWriter
     from dcr_tpu.sampling.pipeline import load_generation_stack
@@ -48,6 +49,11 @@ def main(argv=None) -> None:
     from dcr_tpu.serve.worker import GenerationService
 
     dist.initialize()
+    if cfg.logdir:
+        # spans (request trees, compiles, stage boundaries) -> logdir/
+        # trace.jsonl; flight-recorder dumps (hang exit 89, drain exit 83)
+        # land next to it. Without --logdir the bounded ring still records.
+        tracing.configure(cfg.logdir)
     with R.stage("serve_load"):
         stack = load_generation_stack(SampleConfig(
             model_path=cfg.model_path, iternum=cfg.iternum,
@@ -81,6 +87,9 @@ def main(argv=None) -> None:
     httpd.server_close()       # joins handler threads: responses are on the wire
     if writer is not None:
         writer.close()
+    # exit-83 path: preserve the final seconds (in-flight request spans,
+    # metrics snapshot) for the operator of the restart
+    tracing.dump_flight_recorder("preempted: serve drained")
     log.warning("drained: exiting with code %d for the restart wrapper",
                 EXIT_PREEMPTED)
     raise SystemExit(EXIT_PREEMPTED)

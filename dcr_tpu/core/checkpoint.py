@@ -40,6 +40,7 @@ import orbax.checkpoint as ocp
 
 from dcr_tpu.core import dist
 from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
 
 log = logging.getLogger("dcr_tpu")
 
@@ -298,13 +299,17 @@ class CheckpointManager:
                          timeout_s=self._barrier_timeout)
         if step in self.all_steps():
             return False  # idempotent: final save may coincide with a periodic one
-        if self._verify:
-            self._write_manifest(step, state)
-        if self._npz:
-            saved = self._npz_save(step, state)
-        else:
-            saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                                   force=force)
+        # the span covers manifest hashing + the save *dispatch*; the orbax
+        # backend writes asynchronously, so blocking time (what the train loop
+        # actually lost) is exactly what this measures
+        with tracing.span("ckpt/save", step=int(step)):
+            if self._verify:
+                self._write_manifest(step, state)
+            if self._npz:
+                saved = self._npz_save(step, state)
+            else:
+                saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                       force=force)
         if saved:
             log.info("checkpoint saved at step %d -> %s", step, self._dir / str(step))
             self._prune_manifests(keep=step)
@@ -316,6 +321,10 @@ class CheckpointManager:
         return saved
 
     def _backend_restore(self, step: int, state_like: Any) -> Any:
+        with tracing.span("ckpt/restore", step=int(step)):
+            return self._backend_restore_impl(step, state_like)
+
+    def _backend_restore_impl(self, step: int, state_like: Any) -> Any:
         if self._npz:
             state = self._npz_restore(step, state_like)
         else:

@@ -290,10 +290,24 @@ def hang_abort(name: str, *, coordinator: Optional[Coordinator] = None,
         _abort_started = True
     coordinator = coordinator or _active_coordinator
     last = coordinator.last_agreement if coordinator is not None else None
-    log_event("hang_abort", name=name, detail=detail, exit_code=EXIT_HANG,
-              last_agreement=last)
-    log.error("collective-hang watchdog: aborting %r with exit code %d; "
-              "thread stacks:\n%s", name, EXIT_HANG, dump_stacks())
+    # the abort must reach _exit(EXIT_HANG) even if the post-mortem itself
+    # breaks: a telemetry exception on THIS thread would otherwise kill the
+    # watchdog and leave the pod hung forever — the exact failure this
+    # function exists to end
+    try:
+        log_event("hang_abort", name=name, detail=detail, exit_code=EXIT_HANG,
+                  last_agreement=last)
+        # flight recorder: the last N spans/events before the hang — the
+        # timeline the thread stacks alone can't give (what WAS making
+        # progress, and when it stopped); the dump snapshots fault counters
+        from dcr_tpu.core import tracing
+
+        tracing.dump_flight_recorder(f"hang_abort:{name} ({detail})")
+        log.error("collective-hang watchdog: aborting %r with exit code %d; "
+                  "last trace records: %s; thread stacks:\n%s", name,
+                  EXIT_HANG, tracing.last_span_names(), dump_stacks())
+    except Exception:
+        log.exception("hang_abort post-mortem failed; aborting anyway")
     import jax
 
     if jax.process_count() > 1 and jax.process_index() == 0:

@@ -21,6 +21,8 @@ import numpy as np
 
 import jax
 
+from dcr_tpu.core import tracing
+
 log = logging.getLogger("dcr_tpu")
 
 
@@ -56,12 +58,18 @@ class MetricWriter:
                 log.warning("wandb unavailable (%s); falling back to jsonl/tb", e)
 
     def scalars(self, step: int, values: Mapping[str, Any]) -> None:
-        if not self._active:
-            return
         clean = {}
         for k, v in values.items():
             v = np.asarray(v)
             clean[k] = float(v) if v.ndim == 0 else v.tolist()
+        # every scalar also lands in the process-wide telemetry registry as a
+        # gauge (last value wins) — on EVERY process, not just the writing
+        # primary: each host's flight recorder / metrics endpoint answers for
+        # its own process
+        tracing.update_gauges({k: v for k, v in clean.items()
+                               if isinstance(v, float)})
+        if not self._active:
+            return
         rec = {"step": int(step), "time": time.time(), **clean}
         self._jsonl.write(json.dumps(rec) + "\n")
         self._jsonl.flush()
@@ -93,33 +101,24 @@ class MetricWriter:
             self._wandb.finish()
 
 
-class LatencyTracker:
+class LatencyTracker(tracing.Histogram):
     """Thread-safe sliding-window latency reservoir with percentile snapshots.
 
     Serving telemetry (dcr_tpu/serve/) reports p50/p99 over the last ``window``
     observations — a bounded deque, so a long-lived server never grows memory
     with request count. Averages would hide tail latency, which is the number
     an overloaded service degrades first.
+
+    Storage/percentile mechanics live in :class:`dcr_tpu.core.tracing.Histogram`;
+    passing ``name`` registers this tracker in the process-wide telemetry
+    registry, so its percentiles ride every registry snapshot (flight-recorder
+    dumps, Prometheus text) for free.
     """
 
-    def __init__(self, window: int = 1024):
-        self._values: deque = deque(maxlen=window)
-        self._lock = threading.Lock()
-        self.count = 0
-
-    def observe(self, seconds: float) -> None:
-        with self._lock:
-            self._values.append(float(seconds))
-            self.count += 1
-
-    def percentiles(self, qs: tuple = (50, 99)) -> dict[str, float]:
-        """{"p50": secs, "p99": secs, ...} over the window (0.0 when empty)."""
-        with self._lock:
-            vals = list(self._values)
-        if not vals:
-            return {f"p{q}": 0.0 for q in qs}
-        arr = np.asarray(vals)
-        return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
+    def __init__(self, window: int = 1024, *, name: Optional[str] = None):
+        super().__init__(window=window)
+        if name:
+            tracing.registry().register_histogram(name, self)
 
 
 class SmoothedValue:

@@ -32,47 +32,64 @@ from typing import Any, Callable, Iterator, Optional, Sequence
 
 import logging
 
+from dcr_tpu.core import tracing
+
 log = logging.getLogger("dcr_tpu")
 
 
 def log_event(event: str, **fields: Any) -> None:
-    """One structured, greppable line per fault/recovery action."""
+    """One structured, greppable WARNING line per fault/recovery action.
+
+    The ``[fault]`` prefix is the grep contract for anything that went wrong
+    and was recovered from or aborted on; routine lifecycle/span events go
+    through :func:`log_trace` (INFO, ``[trace]``) instead, so a WARNING-level
+    pipeline stays faults-only. Every fault also lands in the span trace as a
+    ``fault/<event>`` instant, which is what trace_report's fault timeline
+    and the flight recorder's last-moments view are built from."""
     log.warning("[fault] %s %s", event,
                 json.dumps(fields, sort_keys=True, default=str))
+    # attrs= (not **fields): field names like 'name' must not collide with
+    # the event() signature — hang_abort's payload is exactly that case
+    tracing.event(f"fault/{event}", attrs=fields)
+
+
+def log_trace(event: str, **fields: Any) -> None:
+    """Structured INFO line for span/lifecycle events (drain signals, stage
+    boundaries, ...) — same shape as :func:`log_event` but with the
+    ``[trace]`` prefix so fault greps stay stable and quiet runs stay quiet
+    at WARNING level."""
+    log.info("[trace] %s %s", event,
+             json.dumps(fields, sort_keys=True, default=str))
 
 
 # ---------------------------------------------------------------------------
 # Process-wide fault counters
 # ---------------------------------------------------------------------------
 # Shared sink for recovered-from failures that happen below the Trainer
-# (decode fast-path fallbacks, rendezvous teardown errors, ...). The trainer
-# surfaces them through MetricWriter as ``faults/<name>`` at every log
-# boundary, so no swallow is ever invisible on a dashboard. Counters reset
-# with the process; the structured log line each bump pairs with is the
-# durable record.
-
-_counters_lock = threading.Lock()
-_counters: dict[str, int] = {}
+# (decode fast-path fallbacks, rendezvous teardown errors, ...). Backed by
+# the process-wide telemetry registry (core/tracing.py) under ``faults/*``,
+# so the same counters surface through MetricWriter at every trainer log
+# boundary AND through serve's Prometheus endpoint — no swallow is ever
+# invisible on a dashboard. Counters reset with the process; the structured
+# log line each bump pairs with is the durable record.
 
 
 def bump_counter(name: str, n: int = 1) -> int:
     """Increment the process-wide ``faults/<name>`` counter; returns the new
     value. Thread-safe (loader workers bump concurrently)."""
-    with _counters_lock:
-        _counters[name] = _counters.get(name, 0) + n
-        return _counters[name]
+    return tracing.registry().counter(f"faults/{name}").inc(n)
 
 
 def counters() -> dict[str, int]:
-    """Snapshot of all process-wide fault counters."""
-    with _counters_lock:
-        return dict(_counters)
+    """Snapshot of all process-wide fault counters (names without the
+    ``faults/`` registry prefix — callers re-prefix for display)."""
+    prefixed = tracing.registry().counters("faults/")
+    return {k[len("faults/"):]: v for k, v in prefixed.items()}
 
 
 def reset_counters() -> None:
     """Test hook: start a scenario from zero."""
-    with _counters_lock:
-        _counters.clear()
+    tracing.registry().reset("faults/")
 
 
 # ---------------------------------------------------------------------------
@@ -222,7 +239,10 @@ def stage(name: str, deadline: float = 0.0) -> Iterator[Deadline]:
     t0 = time.monotonic()
     log.info("[stage] %s: begin", name)
     try:
-        with watchdog(f"stage:{name}", deadline) as dl:
+        # every stage boundary is also a span (stage/<name>), so eval/serve
+        # pipelines are traced without per-site instrumentation
+        with tracing.span(f"stage/{name}"), \
+                watchdog(f"stage:{name}", deadline) as dl:
             yield dl
     except BaseException as e:
         log_event("stage_failed", stage=name,
@@ -259,7 +279,9 @@ def install_signal_drain(callback: Callable[[int], None],
             _signal.signal(s, _signal.SIG_DFL)
         if not fired.is_set():
             fired.set()
-            log_event("drain_signal", signum=signum)
+            # lifecycle, not a fault: a drain signal is the *expected* way a
+            # preemptible replica stops
+            log_trace("drain_signal", signum=signum)
             callback(signum)
 
     for s in sigs:

@@ -1,0 +1,522 @@
+"""dcr-obs: span tracing, process-wide telemetry registry, flight recorder.
+
+The reference stack's only telemetry is wandb scalars plus MetricLogger
+console meters (SURVEY §5.1) — it cannot answer "where did the step time
+go", "why did the pod hang at 03:00", or "which serve request waited in
+which queue". This module is the measurement substrate every perf PR cites
+numbers from:
+
+- **Span tracer** — ``with span("train/step", step=n): ...`` records one
+  structured span per region: ids/parents propagated via :mod:`contextvars`
+  (so nesting is automatic within a thread), monotonic-clock durations,
+  wall-clock timestamps, rank/thread tags. Spans append to a per-process
+  ``trace.jsonl`` under the run directory once :func:`configure` has run;
+  ``tools/trace_report.py`` turns the files into a stage-time breakdown and
+  a Chrome-trace/Perfetto export.
+- **Telemetry registry** — one process-wide home for counters, gauges and
+  histograms. ``resilience.bump_counter`` feeds ``faults/*`` counters here,
+  ``MetricWriter.scalars`` mirrors every scalar into a gauge, and named
+  :class:`~dcr_tpu.core.metrics.LatencyTracker` instances register as
+  histograms — so the trainer, loader, checkpoint manager, eval runner and
+  the serve worker all report through the same API, and serve's
+  ``/metrics?format=prometheus`` renders the lot in Prometheus text format.
+- **Flight recorder** — a bounded ring of the last N spans/events (always
+  on, even when no trace file is configured). Fatal paths — NaN abort,
+  watchdog exit 89, preemption exit 83, unhandled exceptions — call
+  :func:`dump_flight_recorder`, which writes ``flightrec_<rank>.json`` with
+  the final seconds of activity plus a registry snapshot, the timeline the
+  post-mortems of core/coordination.py previously lacked.
+
+Performance notes: a span is one dict build + deque append + (when a trace
+file is configured) one buffered ``write`` — no locks are held across user
+code. Set ``DCR_TRACE=0`` to keep the ring buffer but skip the file on
+runs where even that is too much. Nothing here touches XLA: on-device
+dispatch is asynchronous, so a span around a jitted call measures dispatch
+(plus any host sync inside the region), which is exactly the host-side
+timeline the trainer's log-boundary ``device_get`` closes.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import itertools
+import json
+import logging
+import os
+import re
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any, Iterator, Mapping, Optional
+
+import numpy as np
+
+log = logging.getLogger("dcr_tpu")
+
+TRACE_VERSION = 1
+# record fields, pinned by tools/trace_schema.json (CI validates every line)
+_PH_SPAN = "X"
+_PH_EVENT = "i"
+
+
+def _detect_rank() -> int:
+    """Lazy rank: jax.distributed may not be initialized when the first span
+    fires (CLI startup), and tracing must never force a backend up."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:  # jax absent/uninitialized in some harness contexts
+        return int(os.environ.get("PROCESS_ID", "0") or 0)
+
+
+class _TraceState:
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.dir: Optional[Path] = None
+        self.file = None
+        self.rank: Optional[int] = None
+        self.ring: deque = deque(
+            maxlen=int(os.environ.get("DCR_FLIGHTREC_SPANS", "256") or 256))
+        self.ids = itertools.count(1)
+        self.dumped: Optional[Path] = None
+
+
+_state = _TraceState()
+_current_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
+    "dcr_current_span", default=None)
+
+
+def configure(directory: str | Path, *, rank: Optional[int] = None) -> Optional[Path]:
+    """Start writing spans/events to ``<directory>/trace.jsonl`` (rank 0) or
+    ``trace.p<rank>.jsonl`` (peers — one file per process, mirroring the
+    quarantine-manifest naming), and anchor flight-recorder dumps there.
+
+    Idempotent and re-targetable (a second configure closes the previous
+    file). ``DCR_TRACE=0`` disables the file sink — spans still feed the
+    flight-recorder ring. Returns the trace path (None when disabled)."""
+    rank = _detect_rank() if rank is None else int(rank)
+    directory = Path(directory)
+    name = "trace.jsonl" if rank == 0 else f"trace.p{rank}.jsonl"
+    # hook before any early return: ring-only mode (DCR_TRACE=0) exists FOR
+    # the unhandled-exception dump, so it needs the excepthook most of all
+    install_excepthook()
+    with _state.lock:
+        _state.rank = rank
+        _state.dir = directory
+        if _state.file is not None:
+            try:
+                _state.file.close()
+            except OSError as e:
+                log.warning("[trace] trace_file_close_failed %r", e)
+            _state.file = None
+        if os.environ.get("DCR_TRACE", "1") == "0":
+            return None
+        directory.mkdir(parents=True, exist_ok=True)
+        path = directory / name
+        _state.file = path.open("a", buffering=1)  # line-buffered: crash-safe
+    return path
+
+
+def trace_dir() -> Optional[Path]:
+    return _state.dir
+
+
+def _rank() -> int:
+    r = _state.rank
+    return _detect_rank() if r is None else r
+
+
+def _emit(rec: dict) -> None:
+    with _state.lock:
+        _state.ring.append(rec)
+        f = _state.file
+        if f is not None:
+            try:
+                f.write(json.dumps(rec, default=str) + "\n")
+            except (OSError, ValueError) as e:  # full disk / closed file:
+                # telemetry must never kill the workload — drop to ring-only
+                _state.file = None
+                log.warning("[trace] trace_write_failed (ring-only from "
+                            "here): %r", e)
+
+
+class SpanHandle:
+    """An open span whose end is decoupled from lexical scope — the
+    cross-thread form (e.g. one ``serve/request`` root per request id,
+    begun on the HTTP handler thread and ended by the future's callback).
+    Prefer :func:`span` whenever a ``with`` block fits."""
+
+    __slots__ = ("name", "id", "parent", "attrs", "_t0_wall", "_t0", "_done")
+
+    def __init__(self, name: str, parent: Optional[int], attrs: dict):
+        self.name = name
+        self.id = next(_state.ids)
+        self.parent = parent
+        self.attrs = attrs
+        self._t0_wall = time.time()
+        self._t0 = time.monotonic()
+        self._done = False
+
+    def end(self, **extra: Any) -> None:
+        if self._done:          # idempotent: future callbacks can race .end()
+            return
+        self._done = True
+        dur = time.monotonic() - self._t0
+        _emit({"ph": _PH_SPAN, "name": self.name, "id": self.id,
+               "parent": self.parent, "ts": round(self._t0_wall * 1e6),
+               "dur": round(dur * 1e6), "pid": _rank(),
+               "tid": threading.get_ident(),
+               "tname": threading.current_thread().name,
+               "args": {**self.attrs, **extra}})
+
+
+def begin_span(name: str, *, parent: Optional[int] = None,
+               **attrs: Any) -> SpanHandle:
+    """Open a :class:`SpanHandle`; the caller owns ``.end()``."""
+    return SpanHandle(name, parent if parent is not None else _current_span.get(),
+                      attrs)
+
+
+@contextmanager
+def span(name: str, *, parent: Optional[int] = None,
+         **attrs: Any) -> Iterator[SpanHandle]:
+    """Record the block as one span. Parent defaults to the enclosing span in
+    this context (contextvars), so nesting is automatic; an exception in the
+    block is recorded as an ``error`` attr and re-raised unchanged."""
+    h = begin_span(name, parent=parent, **attrs)
+    token = _current_span.set(h.id)
+    try:
+        yield h
+    except BaseException as e:
+        h.end(error=repr(e))
+        raise
+    finally:
+        _current_span.reset(token)
+        h.end()
+
+
+def event(name: str, *, parent: Optional[int] = None,
+          attrs: Optional[Mapping[str, Any]] = None, **kw: Any) -> None:
+    """Instant (zero-duration) trace event — compiles, faults, decisions.
+
+    Attributes ride as keywords; pass ``attrs=`` for dicts whose keys could
+    collide with ``name``/``parent`` (e.g. resilience.log_event fields)."""
+    _emit({"ph": _PH_EVENT, "name": name, "id": next(_state.ids),
+           "parent": parent if parent is not None else _current_span.get(),
+           "ts": round(time.time() * 1e6), "pid": _rank(),
+           "tid": threading.get_ident(),
+           "tname": threading.current_thread().name,
+           "args": {**(attrs or {}), **kw}})
+
+
+def complete_span(name: str, *, start_wall: float, dur_s: float,
+                  parent: Optional[int] = None, **attrs: Any) -> None:
+    """Record a span measured elsewhere (e.g. queue wait reconstructed from a
+    request's admission stamp when the batch finally forms)."""
+    _emit({"ph": _PH_SPAN, "name": name, "id": next(_state.ids),
+           "parent": parent, "ts": round(start_wall * 1e6),
+           "dur": round(max(dur_s, 0.0) * 1e6), "pid": _rank(),
+           "tid": threading.get_ident(),
+           "tname": threading.current_thread().name, "args": attrs})
+
+
+def current_span_id() -> Optional[int]:
+    return _current_span.get()
+
+
+# ---------------------------------------------------------------------------
+# Telemetry registry: counters / gauges / histograms
+# ---------------------------------------------------------------------------
+
+class Counter:
+    """Monotonic process-wide counter."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: int = 1) -> int:
+        with self._lock:
+            self._value += n
+            return self._value
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Last-value-wins instantaneous measurement."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Thread-safe sliding-window reservoir with percentile snapshots.
+
+    The storage model of serving's LatencyTracker (which subclasses this):
+    a bounded deque, so long-lived processes never grow memory with
+    observation count, while ``count``/``total`` stay lifetime-accurate."""
+
+    def __init__(self, window: int = 1024):
+        self._values: deque = deque(maxlen=window)
+        self._lock = threading.Lock()
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._values.append(float(value))
+            self.count += 1
+            self.total += float(value)
+
+    def percentiles(self, qs: tuple = (50, 99)) -> dict[str, float]:
+        """{"p50": v, "p99": v, ...} over the window (0.0 when empty)."""
+        with self._lock:
+            vals = list(self._values)
+        if not vals:
+            return {f"p{q}": 0.0 for q in qs}
+        arr = np.asarray(vals)
+        return {f"p{q}": float(np.percentile(arr, q)) for q in qs}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.total
+        return {"count": count, "sum": total,
+                **self.percentiles((50, 90, 99))}
+
+
+class TelemetryRegistry:
+    """The process-wide metric home. Every sink registers here so one
+    snapshot answers for the whole process, whichever subsystem is asked
+    (trainer MetricWriter boundary, serve /metrics, flight-recorder dump)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        with self._lock:
+            return self._histograms.setdefault(name, Histogram(window))
+
+    def register_histogram(self, name: str, hist: Histogram) -> Histogram:
+        """Adopt an externally-created histogram (LatencyTracker(name=...))."""
+        with self._lock:
+            self._histograms[name] = hist
+            return hist
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            for d in (self._counters, self._gauges, self._histograms):
+                d.pop(name, None)
+
+    def counters(self, prefix: str = "") -> dict[str, int]:
+        with self._lock:
+            items = list(self._counters.items())
+        return {k: c.value for k, c in items if k.startswith(prefix)}
+
+    def reset(self, prefix: str = "") -> None:
+        """Test hook: drop metrics under ``prefix`` ("" clears everything)."""
+        with self._lock:
+            for d in (self._counters, self._gauges, self._histograms):
+                for k in [k for k in d if k.startswith(prefix)]:
+                    del d[k]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = list(self._counters.items())
+            gauges = list(self._gauges.items())
+            hists = list(self._histograms.items())
+        return {
+            "counters": {k: c.value for k, c in counters},
+            "gauges": {k: g.value for k, g in gauges},
+            "histograms": {k: h.snapshot() for k, h in hists},
+        }
+
+    def prometheus_text(self) -> str:
+        """The registry in Prometheus text exposition format. Counters/gauges
+        map 1:1; histograms render as summaries (quantile labels + _sum/_count).
+        ``dcr_faults_total`` is always present (0 when clean) so a scrape can
+        alert on its rate before the first fault ever fires."""
+        snap = self.snapshot()
+        lines: list[str] = []
+
+        def san(name: str) -> str:
+            return "dcr_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+        for name, value in sorted(snap["counters"].items()):
+            m = san(name)
+            lines += [f"# TYPE {m} counter", f"{m} {value}"]
+        faults_total = sum(v for k, v in snap["counters"].items()
+                           if k.startswith("faults/"))
+        lines += ["# TYPE dcr_faults_total counter",
+                  f"dcr_faults_total {faults_total}"]
+        for name, value in sorted(snap["gauges"].items()):
+            m = san(name)
+            lines += [f"# TYPE {m} gauge", f"{m} {value}"]
+        for name, h in sorted(snap["histograms"].items()):
+            m = san(name)
+            lines.append(f"# TYPE {m} summary")
+            for q in (50, 90, 99):
+                lines.append(f'{m}{{quantile="0.{q}"}} {h[f"p{q}"]}')
+            lines += [f"{m}_sum {h['sum']}", f"{m}_count {h['count']}"]
+        return "\n".join(lines) + "\n"
+
+
+_registry = TelemetryRegistry()
+
+
+def registry() -> TelemetryRegistry:
+    return _registry
+
+
+def update_gauges(values: Mapping[str, Any], prefix: str = "") -> None:
+    """Mirror a (possibly nested) scalar mapping into registry gauges —
+    how MetricWriter scalars and serve status docs land in /metrics."""
+    for k, v in values.items():
+        if isinstance(v, Mapping):
+            update_gauges(v, prefix=f"{prefix}{k}/")
+        elif isinstance(v, bool):
+            _registry.gauge(f"{prefix}{k}").set(1.0 if v else 0.0)
+        elif isinstance(v, (int, float)):
+            _registry.gauge(f"{prefix}{k}").set(float(v))
+
+
+def merge_counter_rows(rows) -> dict[str, int]:
+    """Pure reduce for the pod-wide fault-counter aggregation: sum each
+    counter across per-host dicts (hosts that never saw a kind contribute
+    nothing). Unit-testable without collectives; the transport is the
+    trainer's timeout-bounded ``dist.kv_allgather`` round."""
+    out: dict[str, int] = {}
+    for row in rows:
+        for name, count in row.items():
+            out[name] = out.get(name, 0) + int(count)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+def flight_records() -> list[dict]:
+    """Snapshot of the bounded last-N span/event ring (newest last)."""
+    with _state.lock:
+        return list(_state.ring)
+
+
+def dump_flight_recorder(reason: str, *,
+                         directory: Optional[str | Path] = None) -> Optional[Path]:
+    """Write ``flightrec_<rank>.json`` — the last N spans/events, a registry
+    snapshot and the abort reason — to ``directory`` (default: the configured
+    trace dir, else ``DCR_FLIGHTREC_DIR``). The post-mortem for every fatal
+    path: NaN abort, watchdog exit 89, preemption exit 83, unhandled
+    exceptions. Never raises (it runs while the process is dying); returns
+    None when no destination is configured or the write fails.
+
+    First dump wins: the record closest to the fault is the post-mortem of
+    record — a NaN abort's explicit dump must not be overwritten by the
+    excepthook firing for the same exception one frame up."""
+    if _state.dumped is not None:
+        return _state.dumped
+    d = directory or _state.dir or os.environ.get("DCR_FLIGHTREC_DIR")
+    if not d:
+        return None
+    rank = _rank()
+    path = Path(d) / f"flightrec_{rank}.json"
+    doc = {
+        "version": TRACE_VERSION,
+        "reason": reason,
+        "time": time.time(),
+        "rank": rank,
+        "os_pid": os.getpid(),
+        "records": flight_records(),
+        "registry": _registry.snapshot(),
+    }
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(doc, indent=1, default=str))
+        tmp.replace(path)      # atomic: a dump raced by the exit never tears
+    except OSError as e:
+        log.warning("[trace] flightrec_write_failed %r", e)
+        return None
+    _state.dumped = path
+    log.warning("[trace] flight_recorder_dumped path=%s reason=%s records=%d",
+                path, reason, len(doc["records"]))
+    return path
+
+
+def last_span_names(n: int = 8) -> list[str]:
+    """The most recent n record names — folded into hang post-mortems so the
+    'where was it' answer survives even when the dump file can't be read."""
+    return [r["name"] for r in flight_records()[-n:]]
+
+
+_orig_excepthook = None
+_hook_lock = threading.Lock()
+
+
+def _excepthook(exc_type, exc, tb) -> None:
+    dump_flight_recorder(f"unhandled_exception: {exc_type.__name__}: {exc}")
+    if _orig_excepthook is not None:
+        _orig_excepthook(exc_type, exc, tb)
+
+
+def install_excepthook() -> None:
+    """Dump the flight recorder on any unhandled exception, then defer to the
+    previous hook. SystemExit never reaches sys.excepthook, so clean exits
+    (and the deliberate preemption exit 83) do not produce a dump here —
+    those paths dump explicitly with their own reason."""
+    global _orig_excepthook
+    with _hook_lock:
+        if sys.excepthook is _excepthook:
+            return
+        _orig_excepthook = sys.excepthook
+        sys.excepthook = _excepthook
+
+
+def reset_for_tests() -> None:
+    """Close the trace file, clear the ring and the registry — scenario
+    isolation for unit tests (mirrors faults.clear())."""
+    with _state.lock:
+        if _state.file is not None:
+            try:
+                _state.file.close()
+            except OSError:
+                log.warning("[trace] trace_file_close_failed during reset")
+        _state.file = None
+        _state.dir = None
+        _state.rank = None
+        _state.dumped = None
+        _state.ring.clear()
+    _registry.reset()

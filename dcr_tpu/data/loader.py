@@ -21,6 +21,7 @@ from typing import Iterator, Optional
 import numpy as np
 
 from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
 from dcr_tpu.core.config import FaultToleranceConfig
 from dcr_tpu.data import duplication as D
 from dcr_tpu.data.dataset import ObjectAttributeDataset
@@ -153,14 +154,19 @@ class DataLoader:
                                      budget_frac=budget_frac)
 
         def make_batch(step: int) -> Batch:
+            # one span per decoded batch, on the worker thread that built it:
+            # the trace separates decode/augment work (here) from the train
+            # thread's wait (train/data_wait) — the pair answers "is the host
+            # keeping the chip fed"
             base = step * self.global_batch_size + self.process_index * self.batch_size
-            examples = [fetch_or_replace(step, base + j)
-                        for j in range(self.batch_size)]
-            return Batch(
-                pixel_values=np.stack([e.pixel_values for e in examples]),
-                input_ids=np.stack([e.input_ids for e in examples]),
-                index=np.asarray([e.index for e in examples], np.int64),
-            )
+            with tracing.span("data/batch", step=step, epoch=epoch):
+                examples = [fetch_or_replace(step, base + j)
+                            for j in range(self.batch_size)]
+                return Batch(
+                    pixel_values=np.stack([e.pixel_values for e in examples]),
+                    input_ids=np.stack([e.input_ids for e in examples]),
+                    index=np.asarray([e.index for e in examples], np.int64),
+                )
 
         def safe_put(item) -> bool:
             # never block forever: re-check stop so consumer-side teardown can't

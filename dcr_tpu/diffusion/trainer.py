@@ -20,9 +20,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import json as _json
+
 from dcr_tpu.core import coordination as C
 from dcr_tpu.core import dist
 from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
 from dcr_tpu.core.checkpoint import CheckpointManager, export_hf_layout
 from dcr_tpu.core.config import TrainConfig, run_name, save_config, to_dict, validate_train_config
 from dcr_tpu.core.metrics import MetricWriter
@@ -170,6 +173,10 @@ class Trainer:
         pidx = dist.process_index()
         qname = "quarantine.jsonl" if pidx == 0 else f"quarantine.p{pidx}.jsonl"
         self.quarantine = R.QuarantineManifest(self.out_dir / qname)
+        # span tracing + flight recorder: per-process trace.jsonl under the
+        # run dir (DCR_TRACE=0 keeps the flight-recorder ring only), and the
+        # anchor for flightrec_<rank>.json on every fatal path
+        tracing.configure(self.out_dir, rank=pidx)
         self.dataset = dataset or ObjectAttributeDataset(
             cfg.data, self.tokenizer, fault=cfg.fault)
         # train_batch_size is per-device (reference semantics: per-GPU batch ×
@@ -492,9 +499,20 @@ class Trainer:
                  max_sync, accum, steps_per_epoch, global_bs)
         while step < max_micro:
             epoch = step // steps_per_epoch
-            for batch in self.loader.epoch(epoch, start_step=step % steps_per_epoch):
-                sharded = pmesh.shard_batch(self.mesh, dict(batch))
-                self.state, metrics = self.step_fn(self.state, sharded, self.train_key)
+            epoch_iter = self.loader.epoch(epoch,
+                                           start_step=step % steps_per_epoch)
+            while True:
+                # span around the fetch: host time spent WAITING on the data
+                # pipeline (the loader's own decode work runs on its worker
+                # threads and is traced there as data/batch spans)
+                with tracing.span("train/data_wait", step=step):
+                    batch = next(epoch_iter, None)
+                if batch is None:
+                    break
+                with tracing.span("train/step", step=step):
+                    sharded = pmesh.shard_batch(self.mesh, dict(batch))
+                    self.state, metrics = self.step_fn(self.state, sharded,
+                                                       self.train_key)
                 step += 1
                 imgs_last += global_bs
                 self.watchdog.beat(step)
@@ -553,6 +571,11 @@ class Trainer:
                             # recovery point. All hosts raise together (same
                             # decision), so no peer is left in a collective.
                             self.ckpt.wait()  # flush pending async writes
+                            # fatal path: preserve the last moments (spans,
+                            # fault counters) before the raise unwinds
+                            tracing.dump_flight_recorder(
+                                f"nan_abort: step {decision.nan_step} loss "
+                                f"{metrics['loss']}")
                             raise FloatingPointError(
                                 f"non-finite loss {metrics['loss']} at step "
                                 f"{decision.nan_step} (ranks {list(decision.nan_ranks)}); "
@@ -565,10 +588,13 @@ class Trainer:
                         from dcr_tpu.utils.profiling import chip_peak_tflops
 
                         # flops_per_step is the per-chip share (post-partition
-                        # cost analysis): per-chip achieved / per-chip peak = MFU
+                        # cost analysis): per-chip achieved / per-chip peak =
+                        # MFU. One naming convention with StepTimer.report:
+                        # bare tflops_per_sec is PER-DEVICE, _total is the job.
                         steps_done = imgs_last / global_bs
                         per_chip = flops_per_step * steps_done / max(dt, 1e-9)
-                        metrics["tflops_per_sec"] = (
+                        metrics["tflops_per_sec"] = per_chip / 1e12
+                        metrics["tflops_per_sec_total"] = (
                             per_chip * jax.device_count() / 1e12)
                         metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
                     # recovery counters: no retry/rollback is ever silent —
@@ -580,6 +606,19 @@ class Trainer:
                     # fast-path fallbacks, kv teardown/gc errors, ...)
                     for name, count in R.counters().items():
                         metrics[f"faults/{name}"] = count
+                    if jax.process_count() > 1:
+                        # pod-wide fault view: aggregate every host's counters
+                        # over the coordination-service KV store (pure gRPC,
+                        # timeout-bounded — no XLA collectives in the control
+                        # plane). Symmetric: every rank reaches this boundary
+                        # in lockstep, so the round can't wedge a peer.
+                        rows = dist.kv_allgather(
+                            _json.dumps(R.counters()), "fault_counters",
+                            timeout_s=dist.default_allgather_timeout_s())
+                        pod = tracing.merge_counter_rows(
+                            _json.loads(r) for r in rows)
+                        for name, count in pod.items():
+                            metrics[f"faults_pod/{name}"] = count
                     self.writer.scalars(sync, metrics)
                     last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
                     t_last, imgs_last = time.time(), 0
@@ -622,6 +661,10 @@ class Trainer:
                         self._uninstall_preemption_handler()
                         self.watchdog.stop()
                         self.preempted_exit = True
+                        # exit-83 path: the final checkpoint is safe; record
+                        # the run's last moments for the restart's operator
+                        tracing.dump_flight_recorder(
+                            f"preempted: checkpointed at step {step}")
                         return last_metrics
                 if at_sync and sync % cfg.modelsavesteps == 0:
                     self.save()

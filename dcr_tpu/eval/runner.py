@@ -215,6 +215,11 @@ def run_eval(cfg: EvalConfig, *, backbone_params: Optional[dict] = None,
 
     mesh = pmesh.make_mesh(cfg.mesh)
     out_dir = Path(cfg.output_dir)
+    # span tracing: every R.stage() boundary below lands in trace.jsonl, so
+    # tools/trace_report.py can break eval wall time down per metric stage
+    from dcr_tpu.core import tracing
+
+    tracing.configure(out_dir)
     # same wandb project name as the reference eval (diff_retrieval.py:380)
     writer = MetricWriter(out_dir / "logs", use_wandb=cfg.use_wandb,
                           wandb_project="imsimv2_retrieval")

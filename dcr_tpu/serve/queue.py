@@ -17,7 +17,7 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
-from typing import NamedTuple, Optional
+from typing import Any, NamedTuple, Optional
 
 
 class AdmissionError(RuntimeError):
@@ -72,6 +72,11 @@ class Request:
     future: Future = field(default_factory=Future)
     enqueued_at: float = 0.0          # time.monotonic, stamped on admission
     cache_hit: Optional[bool] = None  # filled by the worker
+    # tracing.SpanHandle for the serve/request root span (opened at
+    # admission, ended when the future resolves); child spans — queue wait,
+    # device step, respond — parent on its id, giving one span tree per
+    # request id across the handler and worker threads
+    span: Any = None
 
 
 class RequestQueue:

@@ -13,6 +13,9 @@ Endpoints (JSON in/out):
   a draining replica out of rotation before its port closes).
 - ``GET /metrics`` — the :meth:`GenerationService.status` document: queue
   depth, batch occupancy, cache hit rate, p50/p99 latency.
+  ``GET /metrics?format=prometheus`` renders the process-wide telemetry
+  registry (core/tracing.py) — the same document plus ``faults/*`` counters
+  and latency summaries — in Prometheus text exposition format for scrapes.
 
 ``http.server`` is deliberate: zero new dependencies, and the threading
 server's one-thread-per-connection model matches the workload — handler
@@ -30,9 +33,11 @@ import json
 import logging
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
+from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.serve.queue import (BucketLimitError, DrainingError, GenBucket,
                                  InvalidRequestError, QueueFullError)
@@ -93,12 +98,32 @@ class ServeHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _reply_text(self, code: int, text: str,
+                    content_type: str = "text/plain; version=0.0.4") -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self) -> None:
-        if self.path == "/healthz":
+        url = urlparse(self.path)
+        if url.path == "/healthz":
             status = "draining" if self.service.draining else "ok"
             self._reply(200, {"status": status})
-        elif self.path == "/metrics":
-            self._reply(200, self.service.status())
+        elif url.path == "/metrics":
+            fmt = parse_qs(url.query).get("format", ["json"])[0]
+            if fmt == "prometheus":
+                # fold the live service document into registry gauges, then
+                # render the whole registry (incl. faults/* counters and the
+                # request-latency summary) in Prometheus text format
+                status_doc = dict(self.service.status())
+                status_doc.pop("compiled_buckets", None)  # not numeric
+                tracing.update_gauges(status_doc, prefix="serve/")
+                self._reply_text(200, tracing.registry().prometheus_text())
+            else:
+                self._reply(200, self.service.status())
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
 
@@ -141,14 +166,18 @@ class ServeHandler(BaseHTTPRequestHandler):
         except Exception as e:
             self._reply(500, {"error": f"generation failed: {e!r}"})
             return
-        self._reply(200, {
-            "id": req.id,
-            "image_png_b64": base64.b64encode(png_bytes(image)).decode(),
-            "width": int(image.shape[1]),
-            "height": int(image.shape[0]),
-            "cache_hit": bool(req.cache_hit),
-            "latency_ms": None,  # client-side wall time is the honest number
-        })
+        # respond leg of the request's span tree: PNG encode + socket write
+        # happen on this handler thread, off the device worker's critical path
+        with tracing.span("serve/respond", request_id=req.id,
+                          parent=req.span.id if req.span is not None else None):
+            self._reply(200, {
+                "id": req.id,
+                "image_png_b64": base64.b64encode(png_bytes(image)).decode(),
+                "width": int(image.shape[1]),
+                "height": int(image.shape[0]),
+                "cache_hit": bool(req.cache_hit),
+                "latency_ms": None,  # client-side wall time is the honest number
+            })
 
 
 def make_server(cfg: ServeConfig,

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import rng as rngmod
+from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
 from dcr_tpu.models import schedulers as S
@@ -175,7 +176,9 @@ class ServeMetrics:
         self.occupancy_last = 0.0
         self.occupancy_max = 0.0
         self._occupancy_sum = 0.0
-        self.latency = LatencyTracker()
+        # named: registers in the process-wide telemetry registry, so request
+        # latency percentiles ride Prometheus scrapes and flight-rec dumps
+        self.latency = LatencyTracker(name="serve/request_latency_s")
 
     def note_submitted(self) -> None:
         with self._lock:
@@ -291,11 +294,28 @@ class GenerationService:
                 self._admitted_buckets.add(bucket)
             req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
                           bucket=bucket)
+            # root of this request's span tree (admission -> queue wait ->
+            # device step -> respond), closed by the future callback whichever
+            # thread resolves it — so the root span's duration IS the
+            # request's in-service latency. Attached BEFORE queue.submit
+            # publishes the request: the worker can flush a full bucket and
+            # read req.span before this thread runs another line. A rejected
+            # request's handle is simply never ended (nothing is recorded).
+            root = tracing.begin_span("serve/request", parent=None,
+                                      request_id=req.id, seed=req.seed,
+                                      bucket=str(tuple(bucket)))
+            req.span = root
             self.queue.submit(req)
         except AdmissionError as e:
             self.metrics.note_rejected(e)
+            tracing.event("serve/rejected", error=type(e).__name__)
             raise
         self.metrics.note_submitted()
+        # safe after submit: add_done_callback fires immediately on an
+        # already-resolved future, and .end() is idempotent
+        req.future.add_done_callback(
+            lambda f: root.end(error=repr(f.exception()))
+            if f.exception() is not None else root.end())
         return req
 
     # -- lifecycle -----------------------------------------------------------
@@ -335,6 +355,10 @@ class GenerationService:
             if fn is None:
                 log.info("serve: compiling sampler for bucket %s at batch=%d",
                          bucket, self.cfg.max_batch)
+                # trace_report counts these per bucket: with resident-program
+                # reuse working, each bucket compiles exactly once per process
+                tracing.event("serve/compile", bucket=str(tuple(bucket)),
+                              max_batch=self.cfg.max_batch)
                 fn = make_batch_sampler(bucket, self.stack.models,
                                         self.cfg.seed, self.cfg.max_batch)
                 self._samplers[bucket] = fn
@@ -374,14 +398,23 @@ class GenerationService:
         if pad < 0:
             raise ValueError(f"batch of {n} exceeds max_batch={self.cfg.max_batch}")
         fn = self._sampler_for(bucket)
-        mitigation = mitigation_tag(bucket)
-        uncond_row = self._uncond_embedding()
-        cond = np.stack([self._cond_embedding(r, mitigation) for r in requests]
-                        + [uncond_row] * pad)
-        uncond = np.stack([uncond_row] * self.cfg.max_batch)
-        seeds = np.asarray([r.seed for r in requests] + [0] * pad, np.uint32)
-        images = fn(self.stack.params, cond, uncond, seeds)
-        return np.asarray(images)[:n]
+        ids = [r.id for r in requests]
+        # batch assembly: tokenize + text tower (or cache hit) + padding.
+        # Batch-level spans carry the member request ids; the per-request
+        # children (queue wait, respond) parent on each request's root span.
+        with tracing.span("serve/assemble", batch=n, request_ids=ids):
+            mitigation = mitigation_tag(bucket)
+            uncond_row = self._uncond_embedding()
+            cond = np.stack([self._cond_embedding(r, mitigation) for r in requests]
+                            + [uncond_row] * pad)
+            uncond = np.stack([uncond_row] * self.cfg.max_batch)
+            seeds = np.asarray([r.seed for r in requests] + [0] * pad, np.uint32)
+        with tracing.span("serve/device_step", batch=n, request_ids=ids,
+                          bucket=str(tuple(bucket))):
+            # np.asarray forces the transfer, so this span closes only when
+            # the device work is actually done — real step time, not dispatch
+            images = np.asarray(fn(self.stack.params, cond, uncond, seeds))
+        return images[:n]
 
     # -- the drain loop ------------------------------------------------------
 
@@ -393,6 +426,16 @@ class GenerationService:
 
     def _process(self, batch: list[Request]) -> None:
         t0 = time.monotonic()
+        now_wall = time.time()
+        for req in batch:
+            # queue wait measured from the admission stamp, recorded
+            # retroactively under the request's root span: the number the
+            # batcher's deadline policy is supposed to bound
+            waited = t0 - req.enqueued_at
+            tracing.complete_span(
+                "serve/queue_wait", start_wall=now_wall - waited, dur_s=waited,
+                parent=req.span.id if req.span is not None else None,
+                request_id=req.id)
         try:
             # the watchdog turns a wedged device step into a structured
             # post-mortem + EXIT_HANG instead of a silently dead port

@@ -65,7 +65,15 @@ def compiled_flops(fn, *args, **kwargs) -> Optional[float]:
 
 @dataclass
 class StepTimer:
-    """Steady-state step timing + images/sec + MFU."""
+    """Steady-state step timing + images/sec + MFU.
+
+    ``flops_per_step`` is the PER-DEVICE FLOP share (what
+    :func:`flops_of_jitted` returns: post-GSPMD-partitioning cost analysis),
+    so MFU is per-device achieved over per-device peak — dividing by
+    ``device_count`` again, as an earlier revision did, under-reported MFU by
+    exactly that factor. ``tflops_per_sec`` stays the per-device rate the
+    flops input implies; ``tflops_per_sec_total`` scales it to the whole job.
+    """
 
     flops_per_step: Optional[float] = None
     _t0: float = field(default_factory=time.perf_counter)
@@ -86,9 +94,12 @@ class StepTimer:
         if self._items:
             out["items_per_sec"] = self._items / dt
         if self.flops_per_step:
+            # per-device achieved TFLOP/s vs per-device peak: both sides of
+            # the MFU ratio are per-chip, so device_count cancels
             achieved = self.flops_per_step * steps / dt / 1e12
             out["tflops_per_sec"] = achieved
-            out["mfu"] = achieved / (chip_peak_tflops() * jax.device_count())
+            out["tflops_per_sec_total"] = achieved * jax.device_count()
+            out["mfu"] = achieved / chip_peak_tflops()
         if reset:
             self._t0 = time.perf_counter()
             self._steps = self._items = 0

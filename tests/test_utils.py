@@ -39,6 +39,30 @@ def test_step_timer_and_mfu():
     assert "mfu" in rep and rep["mfu"] >= 0
 
 
+def test_step_timer_mfu_formula_is_per_device(monkeypatch):
+    """Pin the MFU formula: flops_per_step is the PER-DEVICE share
+    (flops_of_jitted is post-GSPMD cost analysis), so
+    mfu = (flops_per_step * steps / dt) / (peak * 1e12) with NO device_count
+    in the denominator — a run achieving exactly per-chip peak reports
+    mfu == 1.0 whatever the device count (the old formula divided by
+    device_count and under-reported by that factor)."""
+    import jax
+
+    n_dev = jax.device_count()
+    assert n_dev > 1  # conftest forces 8 virtual devices; the regression
+    #                   is only observable with more than one
+    peak_tflops = profiling.chip_peak_tflops()
+    t = profiling.StepTimer(flops_per_step=peak_tflops * 1e12)  # peak/step/chip
+    t._t0 -= 1.0                      # pretend exactly 1s elapsed
+    monkeypatch.setattr(profiling.time, "perf_counter", lambda: t._t0 + 1.0)
+    t.tick(items=1)
+    rep = t.report()
+    assert rep["mfu"] == pytest.approx(1.0, rel=1e-6)
+    assert rep["tflops_per_sec"] == pytest.approx(peak_tflops, rel=1e-6)
+    assert rep["tflops_per_sec_total"] == pytest.approx(peak_tflops * n_dev,
+                                                        rel=1e-6)
+
+
 def test_compiled_flops_returns_positive():
     import jax.numpy as jnp
 

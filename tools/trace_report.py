@@ -1,0 +1,299 @@
+"""trace_report: turn a run directory's trace.jsonl into answers.
+
+    python -m tools.trace_report <run_dir> [--chrome out.json] [--json]
+
+Reads every ``trace*.jsonl`` the run's processes wrote (core/tracing.py),
+validates each record against the checked-in ``tools/trace_schema.json``,
+and prints the report a perf investigation starts from:
+
+- stage-time breakdown: wall time per span name and per category
+  (data vs step vs ckpt vs eval vs serve), with p50/p99 per name;
+- serve queue-wait percentiles (the ``serve/queue_wait`` spans) and
+  recompile count per bucket (``serve/compile`` events);
+- fault timeline: every ``fault/*`` event in chronological order, plus any
+  flight-recorder dumps present in the directory.
+
+``--chrome`` additionally writes a Chrome-trace JSON (``traceEvents`` array)
+loadable in Perfetto / chrome://tracing. Exit codes: 0 = report produced,
+1 = no trace records found, 2 = schema violations (the trace is corrupt or
+a writer drifted from the schema — CI fails on this).
+
+Pure stdlib on purpose (like tools/lint): runs on a bare checkout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+SCHEMA_PATH = Path(__file__).resolve().parent / "trace_schema.json"
+
+_TYPES = {
+    "string": str,
+    "integer": int,
+    "number": (int, float),
+    "object": dict,
+    "integer_or_null": (int, type(None)),
+}
+
+
+def load_schema(path: Path = SCHEMA_PATH) -> dict:
+    return json.loads(path.read_text())
+
+
+def validate_record(rec: dict, schema: dict) -> list[str]:
+    """Field-level problems with one record ([] = valid)."""
+    problems = []
+    if not isinstance(rec, dict):
+        return [f"record is {type(rec).__name__}, not an object"]
+    for field, tname in schema["required"].items():
+        if field not in rec:
+            problems.append(f"missing required field {field!r}")
+        elif not isinstance(rec[field], _TYPES[tname]) or isinstance(rec[field], bool):
+            problems.append(f"field {field!r} is {type(rec[field]).__name__}, "
+                            f"want {tname}")
+    ph = rec.get("ph")
+    if ph not in schema["allowed_ph"]:
+        problems.append(f"ph={ph!r} not in {schema['allowed_ph']}")
+    if ph == "X":
+        for field, tname in schema["span_required"].items():
+            if field not in rec:
+                problems.append(f"span missing required field {field!r}")
+            elif not isinstance(rec[field], _TYPES[tname]):
+                problems.append(f"span field {field!r} is "
+                                f"{type(rec[field]).__name__}, want {tname}")
+    for field, tname in schema.get("optional", {}).items():
+        if field in rec and not isinstance(rec[field], _TYPES[tname]):
+            problems.append(f"field {field!r} is {type(rec[field]).__name__}, "
+                            f"want {tname}")
+    return problems
+
+
+def load_trace(run_dir: Path, schema: dict) -> tuple[list[dict], list[str]]:
+    """(records, errors) across every trace*.jsonl under run_dir (all ranks)."""
+    records: list[dict] = []
+    errors: list[str] = []
+    for path in sorted(run_dir.glob("trace*.jsonl")):
+        for lineno, line in enumerate(path.read_text().splitlines(), 1):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"{path.name}:{lineno}: not JSON ({e})")
+                continue
+            problems = validate_record(rec, schema)
+            if problems:
+                errors.append(f"{path.name}:{lineno}: " + "; ".join(problems))
+                continue
+            records.append(rec)
+    records.sort(key=lambda r: r["ts"])
+    return records, errors
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+# span-name prefix -> report category (the "where did the time go" buckets)
+_CATEGORIES = (
+    ("train/data_wait", "data"),
+    ("data/", "data"),
+    ("train/step", "step"),
+    ("ckpt/", "ckpt"),
+    ("stage/eval", "eval"),
+    ("serve/", "serve"),
+    ("stage/", "stage"),
+    ("train/", "train"),
+)
+
+
+def category_of(name: str) -> str:
+    for prefix, cat in _CATEGORIES:
+        if name.startswith(prefix):
+            return cat
+    return name.split("/", 1)[0]
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile without numpy (stdlib-only tool)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[int(idx)]
+
+
+def summarize(records: list[dict]) -> dict:
+    """The report document (also the --json output)."""
+    spans = [r for r in records if r["ph"] == "X"]
+    events = [r for r in records if r["ph"] == "i"]
+    by_name: dict[str, list[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s["dur"] / 1e3)  # ms
+    names = {}
+    categories: dict[str, dict] = {}
+    for name, durs in sorted(by_name.items()):
+        durs_sorted = sorted(durs)
+        row = {
+            "count": len(durs),
+            "total_ms": round(sum(durs), 3),
+            "mean_ms": round(sum(durs) / len(durs), 3),
+            "p50_ms": round(_percentile(durs_sorted, 50), 3),
+            "p99_ms": round(_percentile(durs_sorted, 99), 3),
+        }
+        names[name] = row
+        cat = categories.setdefault(category_of(name), {"count": 0, "total_ms": 0.0})
+        cat["count"] += row["count"]
+        cat["total_ms"] = round(cat["total_ms"] + row["total_ms"], 3)
+
+    queue_waits = sorted(by_name.get("serve/queue_wait", []))
+    queue_wait = {
+        "count": len(queue_waits),
+        "p50_ms": round(_percentile(queue_waits, 50), 3),
+        "p90_ms": round(_percentile(queue_waits, 90), 3),
+        "p99_ms": round(_percentile(queue_waits, 99), 3),
+    } if queue_waits else None
+
+    recompiles: dict[str, int] = {}
+    for e in events:
+        if e["name"] == "serve/compile":
+            bucket = str(e["args"].get("bucket", "?"))
+            recompiles[bucket] = recompiles.get(bucket, 0) + 1
+
+    faults = [{
+        "time": time.strftime("%H:%M:%S", time.localtime(e["ts"] / 1e6)),
+        "ts": e["ts"],
+        "rank": e["pid"],
+        "name": e["name"],
+        "args": e["args"],
+    } for e in events if e["name"].startswith("fault/")]
+
+    ranks = sorted({r["pid"] for r in records})
+    span_ts = [s["ts"] for s in spans]
+    return {
+        "records": len(records),
+        "spans": len(spans),
+        "events": len(events),
+        "ranks": ranks,
+        "wall_span_s": (round((max(span_ts) - min(span_ts)) / 1e6, 3)
+                        if span_ts else 0.0),
+        "categories": categories,
+        "by_name": names,
+        "serve_queue_wait": queue_wait,
+        "serve_recompiles_per_bucket": recompiles,
+        "fault_timeline": faults,
+    }
+
+
+def chrome_trace(records: list[dict]) -> dict:
+    """Chrome-trace/Perfetto document: spans -> complete ('X') events, instants
+    -> 'i' events with thread scope, plus thread_name metadata so Perfetto
+    labels rows with real thread names instead of idents."""
+    out = []
+    seen_threads = set()
+    for r in records:
+        key = (r["pid"], r["tid"])
+        if key not in seen_threads:
+            seen_threads.add(key)
+            out.append({"ph": "M", "name": "thread_name", "pid": r["pid"],
+                        "tid": r["tid"], "args": {"name": r["tname"]}})
+        ev = {"ph": r["ph"], "name": r["name"], "ts": r["ts"],
+              "pid": r["pid"], "tid": r["tid"], "cat": category_of(r["name"]),
+              "args": dict(r["args"], id=r["id"], parent=r.get("parent"))}
+        if r["ph"] == "X":
+            ev["dur"] = r["dur"]
+        else:
+            ev["s"] = "t"
+        out.append(ev)
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+# ---------------------------------------------------------------------------
+# rendering
+# ---------------------------------------------------------------------------
+
+def render_text(summary: dict, run_dir: Path) -> str:
+    lines = [f"trace report: {run_dir}",
+             f"  {summary['spans']} spans / {summary['events']} events "
+             f"from ranks {summary['ranks']} over {summary['wall_span_s']}s"]
+    lines.append("\nstage-time breakdown (host wall time per category):")
+    total = sum(c["total_ms"] for c in summary["categories"].values()) or 1.0
+    for cat, row in sorted(summary["categories"].items(),
+                           key=lambda kv: -kv[1]["total_ms"]):
+        lines.append(f"  {cat:<8} {row['total_ms']:>12.1f} ms  "
+                     f"({100 * row['total_ms'] / total:5.1f}%)  "
+                     f"x{row['count']}")
+    lines.append("\nper-span-name:")
+    for name, row in sorted(summary["by_name"].items(),
+                            key=lambda kv: -kv[1]["total_ms"]):
+        lines.append(f"  {name:<24} x{row['count']:<6} total "
+                     f"{row['total_ms']:>10.1f} ms  mean {row['mean_ms']:>8.2f}  "
+                     f"p50 {row['p50_ms']:>8.2f}  p99 {row['p99_ms']:>8.2f}")
+    if summary["serve_queue_wait"]:
+        q = summary["serve_queue_wait"]
+        lines.append(f"\nserve queue wait: x{q['count']}  p50 {q['p50_ms']} ms  "
+                     f"p90 {q['p90_ms']} ms  p99 {q['p99_ms']} ms")
+    if summary["serve_recompiles_per_bucket"]:
+        lines.append("serve compiles per bucket:")
+        for bucket, n in sorted(summary["serve_recompiles_per_bucket"].items()):
+            lines.append(f"  {n}x {bucket}")
+    if summary["fault_timeline"]:
+        lines.append("\nfault timeline:")
+        for f in summary["fault_timeline"]:
+            lines.append(f"  {f['time']} r{f['rank']} {f['name']} {f['args']}")
+    else:
+        lines.append("\nfault timeline: clean (no fault/* events)")
+    flightrecs = sorted(run_dir.glob("flightrec_*.json"))
+    if flightrecs:
+        lines.append("flight-recorder dumps:")
+        for p in flightrecs:
+            try:
+                reason = json.loads(p.read_text()).get("reason", "?")
+            except (OSError, json.JSONDecodeError) as e:
+                reason = f"<unreadable: {e}>"
+            lines.append(f"  {p.name}: {reason}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.trace_report",
+        description="Stage-time breakdown + fault timeline from a run's "
+                    "trace.jsonl; optional Chrome-trace export.")
+    ap.add_argument("run_dir", type=Path,
+                    help="directory holding trace*.jsonl (a run's output_dir "
+                         "or a serve --logdir)")
+    ap.add_argument("--chrome", type=Path, default=None, metavar="OUT.json",
+                    help="also write a Chrome-trace/Perfetto JSON export")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    if not args.run_dir.is_dir():
+        print(f"trace_report: {args.run_dir} is not a directory", file=sys.stderr)
+        return 1
+    schema = load_schema()
+    records, errors = load_trace(args.run_dir, schema)
+    if errors:
+        for e in errors[:20]:
+            print(f"trace_report: SCHEMA: {e}", file=sys.stderr)
+        print(f"trace_report: {len(errors)} invalid record(s)", file=sys.stderr)
+        return 2
+    if not records:
+        print(f"trace_report: no trace records under {args.run_dir} "
+              "(no trace*.jsonl, or all files empty)", file=sys.stderr)
+        return 1
+    summary = summarize(records)
+    if args.chrome:
+        args.chrome.write_text(json.dumps(chrome_trace(records)))
+        print(f"trace_report: wrote chrome trace -> {args.chrome}", file=sys.stderr)
+    print(json.dumps(summary, indent=1) if args.json
+          else render_text(summary, args.run_dir))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
