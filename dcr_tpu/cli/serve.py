@@ -75,7 +75,11 @@ def main(argv=None) -> None:
 
     drained = threading.Event()
     R.install_signal_drain(lambda signum: drained.set())
-    drained.wait()
+    # unbounded BY DESIGN: the main thread's only job is to sleep until the
+    # signal handler fires — there is no peer or producer that could wedge
+    # this wait, and any deadline would just turn an idle server into a
+    # spurious exit
+    drained.wait()  # dcr-lint: disable=DCR009
 
     # drain: stop admission -> finish backlog -> flush in-flight responses
     log.warning("drain: admission stopped; finishing %d queued request(s)",

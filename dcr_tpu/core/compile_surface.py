@@ -1,0 +1,79 @@
+"""Compile-surface registry: every jit entry point declares itself.
+
+A *compile surface* is a function that creates (or is) a ``jax.jit`` program
+the production paths depend on — the train step, the per-bucket serve
+samplers, the bulk samplers, the eval feature extractor. Each one is marked
+at the definition site::
+
+    @compile_surface("train/step")
+    def make_train_step(cfg, models, mesh): ...
+
+Two consumers read the registration:
+
+- **dcr-check DCR010** (tools/check) statically verifies that every jit site
+  in the entry-point modules (``[tool.dcr-check] entry-modules`` in
+  pyproject.toml) lives inside a ``@compile_surface``-decorated function —
+  a new, unregistered jit entry point fails CI before it can introduce an
+  untracked compile;
+- **the compile-surface manifest** (tools/check/surfaces.py) lowers each
+  registered surface under representative configs and fingerprints it into
+  ``compile_manifest.json``; the ``compile-manifest`` CI job diffs the
+  regenerated manifest against the checked-in one, so a recompile hazard —
+  changed static arg, changed input avals, changed donation — is a readable
+  pre-merge failure instead of a silent production recompile. The same
+  fingerprints are the cache keys the planned persistent-executable cache
+  (ROADMAP item 3) will be keyed on.
+
+``manifest=False`` registers a surface for DCR010 without fingerprinting it
+(for inner jits whose shapes are pure run-config, with no stable default);
+the ``reason`` is recorded so the exemption stays auditable.
+
+Import-light on purpose: no jax import, no side effects beyond the registry
+dict — safe to import from every entry module including the serve hot path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, TypeVar
+
+F = TypeVar("F", bound=Callable)
+
+
+@dataclass(frozen=True)
+class SurfaceInfo:
+    """One registered compile surface (a family; manifest entries add a
+    per-variant suffix, e.g. ``serve/batch_sampler@ddim``)."""
+
+    name: str
+    qualname: str          # "module:function"
+    manifest: bool         # fingerprinted into compile_manifest.json?
+    reason: str            # required when manifest=False
+
+
+#: surface name -> registration, populated at import time by the decorators
+REGISTRY: dict[str, SurfaceInfo] = {}
+
+
+def compile_surface(name: str, *, manifest: bool = True,
+                    reason: str = "") -> Callable[[F], F]:
+    """Mark a function as a jit entry point (see module docstring)."""
+    if not manifest and not reason.strip():
+        raise ValueError(
+            f"compile_surface({name!r}, manifest=False) needs a written "
+            "reason — unfingerprinted entry points must stay auditable")
+
+    def deco(fn: F) -> F:
+        info = SurfaceInfo(name=name,
+                           qualname=f"{fn.__module__}:{fn.__qualname__}",
+                           manifest=manifest, reason=reason)
+        prev = REGISTRY.get(name)
+        if prev is not None and prev.qualname != info.qualname:
+            raise ValueError(
+                f"compile surface {name!r} registered twice: "
+                f"{prev.qualname} and {info.qualname}")
+        REGISTRY[name] = info
+        fn.__compile_surface__ = name
+        return fn
+
+    return deco

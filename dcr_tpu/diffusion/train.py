@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import OptimConfig, TrainConfig
 from dcr_tpu.core.precision import policy_from_string
 from dcr_tpu.core import rng as rngmod
@@ -165,6 +166,7 @@ def shard_train_state(state: TrainState, mesh) -> TrainState:
     )
 
 
+@compile_surface("train/step")
 def make_train_step(cfg: TrainConfig, models: DiffusionModels,
                     mesh) -> Callable:
     """Build the jitted train step: (state, batch, root_key) -> (state, metrics).

@@ -24,6 +24,7 @@ import json as _json
 
 from dcr_tpu.core import coordination as C
 from dcr_tpu.core import dist
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 from dcr_tpu.core.checkpoint import CheckpointManager, export_hf_layout
@@ -44,6 +45,7 @@ from dcr_tpu.parallel import mesh as pmesh
 log = logging.getLogger("dcr_tpu")
 
 
+@compile_surface("train/params_finite")
 @jax.jit
 def _params_finite(tree) -> jax.Array:
     """True iff every floating leaf is finite (on-device reduction; used to

@@ -14,6 +14,7 @@ caption json) yielding resize/center-crop/normalized tensors plus captions.
 
 from __future__ import annotations
 
+import functools
 import json
 from pathlib import Path
 from typing import Callable, Iterator, Optional, Sequence
@@ -23,6 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from PIL import Image
 
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.data.dataset import IMG_EXTENSIONS, _resize_shorter_side
 from dcr_tpu.parallel import mesh as pmesh
 
@@ -158,14 +160,23 @@ class EvalImageFolder:
             yield imgs, mask
 
 
+@compile_surface("eval/embed")
 def make_extractor(apply_fn: Callable, params, mesh, *, multiscale: bool = False):
-    """Jitted, mesh-sharded feature extractor: images [B,H,W,3] -> [B, D]."""
+    """Jitted, mesh-sharded feature extractor: images [B,H,W,3] -> [B, D].
+
+    ``params`` ride as a jit ARGUMENT (bound via functools.partial), not a
+    closure constant: XLA would otherwise bake the whole backbone's weights
+    into the executable as constants — doubling resident memory per compiled
+    extractor and making the program un-fingerprintable for the compile-
+    surface manifest. The returned callable keeps the one-arg
+    ``extractor(images)`` contract every caller uses.
+    """
     batch_spec = pmesh.batch_sharding(mesh)
 
-    def forward(images):
+    def forward(p, images):
         images = jax.lax.with_sharding_constraint(images, batch_spec)
         if not multiscale:
-            return apply_fn(params, images)
+            return apply_fn(p, images)
         # 3-scale pooled features (reference utils_ret.py:676-698):
         # mean of features at scales {1, 1/sqrt(2), 1/2}, then L2 normalized
         acc = None
@@ -179,12 +190,12 @@ def make_extractor(apply_fn: Callable, params, mesh, *, multiscale: bool = False
                 # downsample here) never low-pass filters
                 inp = jax.image.resize(images, (b, nh, nw, c),
                                        method="bilinear", antialias=False)
-            feats = apply_fn(params, inp)
+            feats = apply_fn(p, inp)
             acc = feats if acc is None else acc + feats
         acc = acc / 3.0
         return acc / jnp.linalg.norm(acc, axis=-1, keepdims=True)
 
-    return jax.jit(forward)
+    return functools.partial(jax.jit(forward), params)
 
 
 def extract_features(folder: EvalImageFolder, extractor, *,

@@ -28,6 +28,7 @@ import numpy as np
 
 from dcr_tpu.core import dist
 from dcr_tpu.core import resilience as R
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import EvalConfig
 from dcr_tpu.core.metrics import MetricWriter
 from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
@@ -161,6 +162,13 @@ def build_backbone(pt_style: str, arch: str, key: jax.Array,
     return apply_fn, params
 
 
+@compile_surface(
+    "eval/clip_score", manifest=False,
+    reason="inner jit over a caller-supplied mesh and CLIP tower whose "
+           "shapes are pure run config (clip_image_size, text length, "
+           "data-parallel padding); there is no stable default workload to "
+           "fingerprint — the embed surface covers the shared extractor "
+           "wiring, and this score path has no donation or static args")
 def clip_alignment_score(folder: EvalImageFolder, tokenizer: TokenizerBase,
                          mesh, *, scorer_params=None, batch_size: int = 32,
                          clip_image_size: int = 224) -> float:

@@ -19,6 +19,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import SampleConfig
 from dcr_tpu.core import rng as rngmod
 from dcr_tpu.diffusion.train import DiffusionModels
@@ -83,6 +84,7 @@ def scheduler_step(sampler: str, sched, pred: jax.Array, x: jax.Array,
     raise ValueError(f"unknown sampler {sampler!r}")
 
 
+@compile_surface("sample/sampler")
 def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     """Build the jitted sampler: (params, input_ids, uncond_ids, key) -> images.
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import rng as rngmod
 from dcr_tpu.core import tracing
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
 from dcr_tpu.models import schedulers as S
@@ -77,6 +78,7 @@ def validate_bucket(bucket: GenBucket, *, vae_scale: int) -> None:
             f"rand_noise_lam must be in [0, 10], got {bucket.rand_noise_lam}")
 
 
+@compile_surface("serve/batch_sampler")
 def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
                        batch_size: int):
     """Jitted ``(params, cond, uncond, seeds) -> images`` for one bucket.
@@ -157,6 +159,17 @@ def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
         return jnp.clip(images * 0.5 + 0.5, 0.0, 1.0)
 
     return jax.jit(sample_fn)
+
+
+@compile_surface("serve/encode")
+def make_text_encoder(models):
+    """Jitted ``(text_params, ids) -> [B, L, D]`` prompt-embedding step — the
+    text tower every cache miss pays. One compiled program per ids shape;
+    the service always tokenizes to the model's fixed max length, so in
+    practice it compiles once per process."""
+    return jax.jit(
+        lambda text_params, ids: models.text_encoder.apply(
+            {"params": text_params}, ids).last_hidden_state)
 
 
 class ServeMetrics:
@@ -257,10 +270,7 @@ class GenerationService:
         # a misconfigured default bucket must fail at STARTUP, not boot a
         # healthy-looking replica that 400s every default request
         validate_bucket(self.default_bucket(), vae_scale=self._vae_scale)
-        models = stack.models
-        self._encode = jax.jit(
-            lambda text_params, ids: models.text_encoder.apply(
-                {"params": text_params}, ids).last_hidden_state)
+        self._encode = make_text_encoder(stack.models)
         self._tok_fp = stack.tokenizer.fingerprint()
         self._uncond: Optional[np.ndarray] = None
         self._stop = threading.Event()

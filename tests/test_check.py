@@ -1,0 +1,772 @@
+"""dcr-check self-tests: interprocedural rules + compile-surface manifest.
+
+Layers mirror the dcr-lint self-tests (tests/test_lint.py):
+
+1. per-rule positive/negative fixtures for the whole-program rules — each
+   fixture is a *multi-module* tmp package, because the point of dcr-check
+   is exactly the facts that cross a file boundary;
+2. the manifest machinery on tiny synthetic surfaces — fingerprints are
+   deterministic, an injected recompile hazard (changed static arg, changed
+   aval, changed donation) produces a detected AND readable diff;
+3. the repo self-scan — ``python -m tools.check --no-manifest`` is clean on
+   this tree, the checked-in compile_manifest.json covers every registered
+   surface, and the acceptance surfaces (train step, all default serve
+   buckets, both/all samplers, eval embed) are present.
+
+The rule fixtures are pure-AST (no jax import at check time) and ride the
+fast tier; the synthetic-manifest tests use one trivial jitted lambda.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from tools.check.config import CheckConfig
+from tools.check.engine import scan_program
+from tools.check.graph import load_program
+from tools.check.rules import registered_surfaces
+
+pytestmark = pytest.mark.fast
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def write_pkg(root: Path, files: dict[str, str]) -> None:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src, encoding="utf-8")
+
+
+def program_rules(tmp_path: Path, files: dict[str, str], *,
+                  hot_paths=(), entry_modules=()) -> list:
+    write_pkg(tmp_path, files)
+    cfg = CheckConfig(roots=("pkg",), hot_paths=tuple(hot_paths),
+                      entry_modules=tuple(entry_modules), root=tmp_path,
+                      manifest="compile_manifest.json")
+    findings, _, _ = scan_program(cfg)
+    return findings
+
+
+def rule_set(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# 1a. interprocedural DCR002 — donation across function/module boundaries
+# ---------------------------------------------------------------------------
+
+TRAINLIB = """
+import jax
+def make_step(cfg):
+    def step(state, batch):
+        return state
+    return jax.jit(step, donate_argnums=(0,))
+"""
+
+
+def test_x002_cross_module_builder_use_after_donation(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/driver.py": """
+from pkg.trainlib import make_step
+def run(cfg, state, batch):
+    step = make_step(cfg)
+    new = step(state, batch)
+    return state, new
+""",
+    })
+    assert rule_set(findings) == {"DCR002"}
+    (f,) = findings
+    assert "make_step" in f.message and "state" in f.message
+
+
+def test_x002_rebinding_is_clean(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/driver.py": """
+from pkg.trainlib import make_step
+def run(cfg, state, batches):
+    step = make_step(cfg)
+    for b in batches:
+        state = step(state, b)
+    return state
+""",
+    })
+    assert findings == []
+
+
+def test_x002_loop_without_rebind(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/driver.py": """
+from pkg.trainlib import make_step
+def run(cfg, state, batches):
+    step = make_step(cfg)
+    out = None
+    for b in batches:
+        out = step(state, b)
+    return out
+""",
+    })
+    assert rule_set(findings) == {"DCR002"}
+
+
+def test_x002_loop_with_later_rebind_is_clean(tmp_path):
+    # `new = step(state, b); state = new` rebinds the donated chain on a
+    # LATER statement of the loop body — fresh before the next iteration,
+    # so this is the correct idiom, not a hazard
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/driver.py": """
+from pkg.trainlib import make_step
+def run(cfg, state, batches):
+    step = make_step(cfg)
+    for b in batches:
+        new_state = step(state, b)
+        state = new_state
+    return state
+""",
+    })
+    assert rule_set(findings) == set()
+
+
+def test_x002_class_attr_donation_across_methods(tmp_path):
+    trainer = """
+from pkg.trainlib import make_step
+class Trainer:
+    def __init__(self, cfg, state):
+        self.step_fn = make_step(cfg)
+        self.state = state
+    def run(self, batch):
+        out = self.step_fn(self.state, batch)
+        print(self.state)
+        return out
+"""
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/trainer.py": trainer,
+    })
+    assert rule_set(findings) == {"DCR002"}
+    # the real Trainer idiom — `self.state, m = self.step_fn(self.state, b)`
+    # — rebinds in place and must stay clean
+    clean = trainer.replace(
+        "        out = self.step_fn(self.state, batch)\n"
+        "        print(self.state)\n"
+        "        return out\n",
+        "        self.state, m = self.step_fn(self.state, batch)\n"
+        "        return self.state, m\n")
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/trainlib.py": TRAINLIB,
+        "pkg/trainer.py": clean,
+    })
+    assert findings == []
+
+
+def test_x002_imported_jitted_donating_fn(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/steps.py": """
+import jax
+from functools import partial
+@partial(jax.jit, donate_argnums=(0,))
+def apply_update(state, grads):
+    return state
+""",
+        "pkg/use.py": """
+from pkg.steps import apply_update
+def run(state, grads):
+    new = apply_update(state, grads)
+    return state.step, new
+""",
+    })
+    assert rule_set(findings) == {"DCR002"}
+
+
+# ---------------------------------------------------------------------------
+# 1b. interprocedural DCR003 — a key consumed through callees
+# ---------------------------------------------------------------------------
+
+DRAWLIB = """
+import jax
+def draw_noise(key, shape):
+    return jax.random.normal(key, shape)
+def draw_mask(key, shape):
+    return jax.random.bernoulli(key, 0.5, shape)
+"""
+
+
+def test_x003_key_to_two_consuming_callees(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/drawlib.py": DRAWLIB,
+        "pkg/use.py": """
+from pkg.drawlib import draw_noise, draw_mask
+def f(key):
+    a = draw_noise(key, (2,))
+    b = draw_mask(key, (2,))
+    return a, b
+""",
+    })
+    assert rule_set(findings) == {"DCR003"}
+    assert "draw_mask" in findings[0].message
+
+
+def test_x003_split_before_callees_is_clean(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/drawlib.py": DRAWLIB,
+        "pkg/use.py": """
+import jax
+from pkg.drawlib import draw_noise, draw_mask
+def f(key):
+    k1, k2 = jax.random.split(key)
+    a = draw_noise(k1, (2,))
+    b = draw_mask(k2, (2,))
+    return a, b
+""",
+    })
+    assert findings == []
+
+
+def test_x003_fold_in_helper_does_not_consume(tmp_path):
+    # the repo's stream_key idiom: a helper that only DERIVES (fold_in) may
+    # see the same root key many times — that is the sanctioned pattern
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/rnglib.py": """
+import jax
+def stream_key(root, tag):
+    return jax.random.fold_in(root, tag)
+""",
+        "pkg/use.py": """
+from pkg.rnglib import stream_key
+def f(key):
+    k1 = stream_key(key, 1)
+    k2 = stream_key(key, 2)
+    return k1, k2
+""",
+    })
+    assert findings == []
+
+
+def test_x003_transitive_consumption_and_loop(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/drawlib.py": DRAWLIB,
+        "pkg/mid.py": """
+from pkg.drawlib import draw_noise
+def sample_row(key, shape):
+    return draw_noise(key, shape)
+""",
+        "pkg/use.py": """
+from pkg.mid import sample_row
+def f(key, n):
+    out = []
+    for i in range(n):
+        out.append(sample_row(key, (2,)))
+    return out
+""",
+    }
+    findings = program_rules(tmp_path, files)
+    assert rule_set(findings) == {"DCR003"}
+    assert "every iteration" in findings[0].message
+
+
+def test_x003_exclusive_branches_clean(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/drawlib.py": DRAWLIB,
+        "pkg/use.py": """
+from pkg.drawlib import draw_noise, draw_mask
+def f(key, cond):
+    if cond:
+        return draw_noise(key, (2,))
+    else:
+        return draw_mask(key, (2,))
+""",
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# 1c. interprocedural DCR004 — wrappers that drop the collective timeout
+# ---------------------------------------------------------------------------
+
+SYNCLIB = """
+def my_gather(payload, tag, timeout_s=0):
+    from pkg import dist
+    return dist.kv_allgather(payload, tag, timeout_s)
+"""
+
+
+def test_x004_wrapper_unbounded_default(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/dist.py": "def kv_allgather(payload, tag, timeout_s):\n"
+                       "    return [payload]\n",
+        "pkg/synclib.py": SYNCLIB,
+        "pkg/use.py": """
+from pkg.synclib import my_gather
+def sync(x):
+    return my_gather(x, "t")
+""",
+    })
+    assert rule_set(findings) == {"DCR004"}
+    assert "my_gather" in findings[0].message and "timeout_s" in findings[0].message
+
+
+def test_x004_threaded_timeout_is_clean(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/dist.py": "def kv_allgather(payload, tag, timeout_s):\n"
+                       "    return [payload]\n",
+        "pkg/synclib.py": SYNCLIB,
+        "pkg/use.py": """
+from pkg.synclib import my_gather
+def sync(x, t):
+    return my_gather(x, "t", timeout_s=t)
+""",
+    })
+    assert findings == []
+
+
+def test_x004_zero_timeout_at_wrapper_call_site(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/dist.py": "def kv_allgather(payload, tag, timeout_s):\n"
+                       "    return [payload]\n",
+        "pkg/synclib.py": """
+def my_gather(payload, tag, timeout_s):
+    from pkg import dist
+    return dist.kv_allgather(payload, tag, timeout_s)
+""",
+        "pkg/use.py": """
+from pkg.synclib import my_gather
+def sync(x):
+    return my_gather(x, "t", timeout_s=0)
+""",
+    })
+    assert rule_set(findings) == {"DCR004"}
+
+
+# ---------------------------------------------------------------------------
+# 1d. DCR009 — untimed blocking waits on hot paths
+# ---------------------------------------------------------------------------
+
+HOT = dict(hot_paths=("pkg/serve/",))
+
+
+def test_dcr009_untimed_queue_get(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "", "pkg/serve/__init__.py": "",
+        "pkg/serve/worker.py": """
+import queue
+q = queue.Queue()
+def drain():
+    return q.get()
+""",
+    }, **HOT)
+    assert rule_set(findings) == {"DCR009"}
+
+
+def test_dcr009_event_wait_and_thread_join(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "", "pkg/serve/__init__.py": "",
+        "pkg/serve/worker.py": """
+import threading
+class W:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=lambda: None)
+    def wait_forever(self):
+        self._stop.wait()
+    def join_forever(self):
+        self._thread.join()
+""",
+    }, **HOT)
+    assert sorted(f.message.split("(")[0] for f in findings) and \
+        rule_set(findings) == {"DCR009"}
+    assert len(findings) == 2
+
+
+def test_dcr009_bounded_and_nonblocking_are_clean(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "", "pkg/serve/__init__.py": "",
+        "pkg/serve/worker.py": """
+import queue, threading
+q = queue.Queue()
+ev = threading.Event()
+def ok(t):
+    a = q.get(timeout=1.0)
+    b = q.get(False)
+    c = q.get_nowait()
+    ev.wait(t if t else 5.0)
+    return a, b, c
+""",
+    }, **HOT)
+    assert findings == []
+
+
+def test_dcr009_future_result_and_scope(tmp_path):
+    files = {
+        "pkg/__init__.py": "", "pkg/serve/__init__.py": "",
+        "pkg/serve/handler.py": """
+def answer(req):
+    return req.future.result()
+""",
+        "pkg/data.py": """
+import queue
+q = queue.Queue()
+def drain():
+    return q.get()
+""",
+    }
+    # future.result() untimed in the hot path is flagged; the identical
+    # Queue.get outside the hot-path scope is NOT (precision by scoping)
+    findings = program_rules(tmp_path, files, **HOT)
+    assert rule_set(findings) == {"DCR009"}
+    assert all(f.path.startswith("pkg/serve/") for f in findings)
+
+
+def test_dcr009_pragma_suppression(tmp_path):
+    write_pkg(tmp_path, {
+        "pkg/__init__.py": "", "pkg/serve/__init__.py": "",
+        "pkg/serve/worker.py": """
+import threading
+ev = threading.Event()
+def wait_for_signal():
+    ev.wait()  # dcr-lint: disable=DCR009
+""",
+    })
+    cfg = CheckConfig(roots=("pkg",), hot_paths=("pkg/serve/",),
+                      entry_modules=(), root=tmp_path)
+    findings, suppressed, _ = scan_program(cfg)
+    assert findings == [] and suppressed == 1
+
+
+# ---------------------------------------------------------------------------
+# 1e. DCR010 — unregistered jit entry points
+# ---------------------------------------------------------------------------
+
+def test_dcr010_unregistered_jit_entry(tmp_path):
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/worker.py": """
+import jax
+def make_sampler(cfg):
+    def sample(params, x):
+        return x
+    return jax.jit(sample)
+""",
+    }, entry_modules=("pkg/worker.py",))
+    assert rule_set(findings) == {"DCR010"}
+    assert "not registered" in findings[0].message
+
+
+def test_dcr010_registered_jit_entry_is_clean(tmp_path):
+    manifest = {"version": 1, "entries": {
+        "serve/sampler@default": {"surface": "serve/sampler"}}}
+    (tmp_path / "compile_manifest.json").write_text(json.dumps(manifest))
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/surface.py": """
+def compile_surface(name, manifest=True, reason=""):
+    def deco(fn):
+        return fn
+    return deco
+""",
+        "pkg/worker.py": """
+import jax
+from pkg.surface import compile_surface
+@compile_surface("serve/sampler")
+def make_sampler(cfg):
+    def sample(params, x):
+        return x
+    return jax.jit(sample)
+""",
+    }, entry_modules=("pkg/worker.py",))
+    assert findings == []
+
+
+def test_dcr010_registered_surface_missing_from_manifest(tmp_path):
+    # same registered surface but an empty manifest -> coverage finding
+    (tmp_path / "compile_manifest.json").write_text(
+        json.dumps({"version": 1, "entries": {}}))
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/surface.py": """
+def compile_surface(name, manifest=True, reason=""):
+    def deco(fn):
+        return fn
+    return deco
+""",
+        "pkg/worker.py": """
+import jax
+from pkg.surface import compile_surface
+@compile_surface("serve/sampler")
+def make_sampler(cfg):
+    def sample(params, x):
+        return x
+    return jax.jit(sample)
+""",
+    }, entry_modules=("pkg/worker.py",))
+    assert rule_set(findings) == {"DCR010"}
+    assert "no entry" in findings[0].message
+
+
+def test_dcr010_manifest_false_is_exempt(tmp_path):
+    (tmp_path / "compile_manifest.json").write_text(
+        json.dumps({"version": 1, "entries": {}}))
+    findings = program_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/surface.py": """
+def compile_surface(name, manifest=True, reason=""):
+    def deco(fn):
+        return fn
+    return deco
+""",
+        "pkg/worker.py": """
+import jax
+from pkg.surface import compile_surface
+@compile_surface("serve/score", manifest=False, reason="run-config shapes")
+def make_scorer(cfg):
+    return jax.jit(lambda p, x: x)
+""",
+    }, entry_modules=("pkg/worker.py",))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# 2. manifest machinery on synthetic surfaces
+# ---------------------------------------------------------------------------
+
+def _toy_entry(steps: int, donate: bool = False, batch: int = 4) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tools.check.manifest import fingerprint
+
+    def body(x, y):
+        for _ in range(steps):
+            x = x * y + 1.0
+        return x
+
+    fn = jax.jit(body, donate_argnums=(0,) if donate else ())
+    aval = jax.ShapeDtypeStruct((batch, 3), jnp.float32)
+    return fingerprint("toy/surface@default", fn, (aval, aval),
+                       static_config={"steps": steps},
+                       donate_argnums=(0,) if donate else (),
+                       surface="toy/surface")
+
+
+def test_fingerprint_is_deterministic_and_abstract():
+    e1 = _toy_entry(3)
+    e2 = _toy_entry(3)
+    assert e1 == e2
+    assert e1["in_avals"]["leaves"] == 2
+    assert e1["out_avals"]["detail"] == [".: float32[4, 3]"]
+    assert e1["donated_inputs"] == 0
+
+
+def test_fingerprint_records_donation():
+    e = _toy_entry(3, donate=True)
+    assert e["donate_argnums"] == [0] and e["donated_inputs"] == 1
+
+
+def test_manifest_diff_detects_injected_static_arg_change():
+    # the satellite regression: inject a recompile hazard (a changed static
+    # arg) and require the diff to be detected AND readable
+    from tools.check.manifest import build_manifest, diff_manifests
+
+    old = build_manifest({"toy/surface@default": _toy_entry(3)})
+    new = build_manifest({"toy/surface@default": _toy_entry(4)})
+    diff = diff_manifests(old, new)
+    assert diff, "a changed static arg must produce a manifest diff"
+    text = "\n".join(diff)
+    assert "toy/surface@default" in text
+    assert "static_config.steps" in text and "3" in text and "4" in text
+    assert "recompile" in text
+    # the changed loop bound also changes the program itself
+    assert (old["entries"]["toy/surface@default"]["lowered_sha256"]
+            != new["entries"]["toy/surface@default"]["lowered_sha256"])
+
+
+def test_manifest_diff_detects_aval_change_readably():
+    from tools.check.manifest import build_manifest, diff_manifests
+
+    old = build_manifest({"toy/surface@default": _toy_entry(3, batch=4)})
+    new = build_manifest({"toy/surface@default": _toy_entry(3, batch=8)})
+    diff = "\n".join(diff_manifests(old, new))
+    assert "in_avals" in diff
+    assert "float32[4, 3]" in diff and "float32[8, 3]" in diff
+
+
+def test_manifest_diff_detects_donation_change():
+    from tools.check.manifest import build_manifest, diff_manifests
+
+    old = build_manifest({"toy/surface@default": _toy_entry(3)})
+    new = build_manifest({"toy/surface@default": _toy_entry(3, donate=True)})
+    diff = "\n".join(diff_manifests(old, new))
+    assert "donate_argnums" in diff and "use-after-donation" in diff
+
+
+def test_manifest_diff_new_and_removed_entries():
+    from tools.check.manifest import build_manifest, diff_manifests
+
+    base = build_manifest({"toy/surface@default": _toy_entry(3)})
+    grown = build_manifest({"toy/surface@default": _toy_entry(3),
+                            "toy/other@default": _toy_entry(2)})
+    diff = "\n".join(diff_manifests(base, grown))
+    assert "toy/other@default" in diff and "NEW entry point" in diff
+    diff = "\n".join(diff_manifests(grown, base))
+    assert "entry removed" in diff
+
+
+def test_manifest_clean_roundtrip(tmp_path):
+    from tools.check.manifest import (build_manifest, diff_manifests,
+                                      load_manifest, write_manifest)
+
+    m = build_manifest({"toy/surface@default": _toy_entry(3)})
+    write_manifest(tmp_path / "m.json", m)
+    loaded = load_manifest(tmp_path / "m.json")
+    assert diff_manifests(loaded, m) == []
+
+
+def test_manifest_jax_version_mismatch_skips_hlo_digest():
+    from tools.check.manifest import build_manifest, diff_manifests
+
+    old = build_manifest({"toy/surface@default": _toy_entry(3)})
+    old["jax_version"] = "0.0.0-other"
+    new = build_manifest({"toy/surface@default": _toy_entry(3)})
+    # identical shapes/statics, different recorded jax version: the HLO
+    # digest must not be compared, so the diff stays empty
+    assert diff_manifests(old, new) == []
+
+
+# ---------------------------------------------------------------------------
+# 3. repo self-scan — what the static-analysis + compile-manifest jobs gate
+# ---------------------------------------------------------------------------
+
+def test_repo_program_scan_is_clean():
+    from tools.check.config import load_check_config
+    from tools.check.engine import scan_program
+
+    cfg = load_check_config(pyproject=REPO / "pyproject.toml")
+    findings, _, n_modules = scan_program(cfg)
+    pretty = "\n".join(f"{f.path}:{f.line}: {f.rule} {f.message}"
+                       for f in findings)
+    assert findings == [], f"whole-program findings:\n{pretty}"
+    assert n_modules > 50
+
+
+def test_repo_registered_surfaces_match_expectations():
+    from tools.check.config import load_check_config
+
+    cfg = load_check_config(pyproject=REPO / "pyproject.toml")
+    index = load_program(cfg.root, cfg.roots, cfg.exclude)
+    surfaces = registered_surfaces(index, cfg)
+    assert surfaces == {
+        "train/step": True,
+        "train/params_finite": True,
+        "serve/batch_sampler": True,
+        "serve/encode": True,
+        "sample/sampler": True,
+        "eval/embed": True,
+        "eval/clip_score": False,
+    }
+
+
+def test_checked_in_manifest_covers_acceptance_surfaces():
+    data = json.loads((REPO / "compile_manifest.json").read_text())
+    entries = data["entries"]
+    by_surface: dict[str, set] = {}
+    for e in entries.values():
+        by_surface.setdefault(e["surface"], set()).add(e["variant"])
+    # the acceptance list: train step, every default serve bucket sampler,
+    # both/all samplers, eval embed step
+    assert "default" in by_surface["train/step"]
+    assert by_surface["serve/batch_sampler"] == {"ddim", "dpm++", "ddpm"}
+    assert by_surface["sample/sampler"] == {"ddim", "dpm++", "ddpm"}
+    assert "default" in by_surface["eval/embed"]
+    for entry in entries.values():
+        assert entry["lowered_sha256"] and entry["in_avals"]["leaves"] > 0
+        # every serve bucket records the default bucket's static knobs
+        if entry["surface"] == "serve/batch_sampler":
+            assert entry["static_config"]["resolution"] == 256
+            assert entry["static_config"]["steps"] == 50
+
+
+def test_surface_specs_agree_with_registrations():
+    # tools/check/surfaces.py must build >=1 variant for every manifest=True
+    # registration — the same invariant check_manifest_coverage enforces on
+    # the checked-in JSON, asserted here at the spec level
+    from tools.check.config import load_check_config
+    from tools.check.surfaces import SURFACES
+
+    cfg = load_check_config(pyproject=REPO / "pyproject.toml")
+    index = load_program(cfg.root, cfg.roots, cfg.exclude)
+    registered = registered_surfaces(index, cfg)
+    spec_surfaces = {s.surface for s in SURFACES}
+    want = {name for name, m in registered.items() if m}
+    assert spec_surfaces == want
+
+
+def _run_cli(*argv, cwd=REPO):
+    return subprocess.run([sys.executable, "-m", "tools.check", *argv],
+                          capture_output=True, text=True, cwd=cwd)
+
+
+def test_cli_no_manifest_is_clean_on_repo():
+    proc = _run_cli("--no-manifest")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 findings" in proc.stdout
+
+
+def test_cli_program_only_skips_file_local_scan():
+    # the CI static-analysis job runs dcr-lint separately; --program-only
+    # must not re-report (and re-annotate) the file-local layer
+    proc = _run_cli("--no-manifest", "--program-only")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert " 0 files" in proc.stdout  # file-local layer did not run
+
+
+def test_cli_github_format(tmp_path):
+    # a seeded DCR009 under a fake repo root surfaces as a ::error line
+    import os
+
+    write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/serve/__init__.py": "",
+        "pkg/serve/w.py": "import queue\nq = queue.Queue()\n"
+                          "def d():\n    return q.get()\n",
+        # stubs for the file-local lint layer's default scan paths
+        "dcr_tpu/__init__.py": "", "tests/__init__.py": "",
+        "tools/keep.py": "KEEP = 1\n",
+        "pyproject.toml": """
+[tool.dcr-check]
+roots = ["pkg"]
+entry-modules = []
+hot-paths = ["pkg/serve/"]
+manifest = "compile_manifest.json"
+""",
+    })
+    env = dict(os.environ, PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.check", "--no-manifest",
+         "--format", "github", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "::error file=pkg/serve/w.py" in proc.stdout
+    assert "title=DCR009" in proc.stdout

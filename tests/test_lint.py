@@ -530,6 +530,120 @@ def test_cli_list_rules():
 
 
 # ---------------------------------------------------------------------------
+# unreadable-input edge cases — each a structured exit-2 diagnostic, never a
+# traceback: an unparseable file means the scan is INCOMPLETE, which must not
+# read as either "clean" (0) or an ordinary finding (1)
+# ---------------------------------------------------------------------------
+
+def test_cli_syntax_error_file_exits_2(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n", encoding="utf-8")
+    proc = _run_cli(str(bad), "--no-baseline")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "Traceback" not in proc.stderr
+    # the DCR000 pseudo-finding is the structured diagnostic
+    assert "DCR000" in proc.stdout
+    assert "could not be parsed" in proc.stderr
+    assert "broken.py" in proc.stderr
+
+
+def test_cli_non_utf8_file_exits_2(tmp_path):
+    bad = tmp_path / "latin1.py"
+    bad.write_bytes(b"# caf\xe9\nx = 1\n")
+    proc = _run_cli(str(bad), "--no-baseline")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "Traceback" not in proc.stderr
+    assert "not valid UTF-8" in proc.stderr
+    assert "latin1.py" in proc.stderr
+
+
+def test_cli_empty_file_exits_2(tmp_path):
+    empty = tmp_path / "empty.py"
+    empty.write_text("", encoding="utf-8")
+    proc = _run_cli(str(empty), "--no-baseline")
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "Traceback" not in proc.stderr
+    assert "empty file" in proc.stderr
+    assert "empty.py" in proc.stderr
+    # an empty file inside a scanned DIRECTORY is not an error: only an
+    # explicitly named empty file marks a misconfigured invocation
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("", encoding="utf-8")
+    (pkg / "ok.py").write_text("x = 1\n", encoding="utf-8")
+    assert _run_cli(str(pkg), "--no-baseline").returncode == 0
+
+
+def test_baselined_parse_failure_still_exits_2(tmp_path):
+    # a DCR000 entry in the baseline must NOT turn an unparseable file into
+    # a "clean" exit-0 scan — parse failures can never be grandfathered
+    bad = tmp_path / "broken.py"
+    bad.write_text("def broken(:\n", encoding="utf-8")
+    (tmp_path / "baseline.json").write_text(json.dumps({"entries": [
+        {"rule": "DCR000", "path": "broken.py", "snippet": "def broken(:",
+         "justification": "fixture: someone tried to grandfather a parse "
+                          "failure — must not work"}]}))
+    proc = _run_cli(str(bad), "--baseline", str(tmp_path / "baseline.json"))
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "could not be parsed" in proc.stderr
+    # and --write-baseline refuses to record DCR000 in the first place
+    from tools.lint.engine import write_baseline
+    from tools.lint.rules import Finding
+    out = tmp_path / "bl2.json"
+    write_baseline(out, [Finding(rule="DCR000", path="broken.py", line=1,
+                                 col=0, message="syntax error", snippet="")])
+    assert json.loads(out.read_text())["entries"] == []
+
+
+def test_dcr002_loop_with_later_rebind_is_clean():
+    # `new = step(state, b); state = new` rebinds before the next iteration
+    src = """
+import jax
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+def train(state, batches):
+    for b in batches:
+        new = step(state, b)
+        state = new
+    return state
+"""
+    assert "DCR002" not in rules_of(src)
+    # the loop target itself is a fresh binding every iteration too
+    src2 = """
+import jax
+step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+def train(states, b):
+    for state in states:
+        step(state, b)
+"""
+    assert "DCR002" not in rules_of(src2)
+
+
+def test_stale_baseline_reported_for_deleted_file(tmp_path):
+    # an entry whose file no longer EXISTS is stale even when that file is
+    # not in the scanned path set — a deleted file can never match any scan
+    scanned = tmp_path / "pkg"
+    scanned.mkdir()
+    (scanned / "m.py").write_text("x = 1\n", encoding="utf-8")
+    unscanned = tmp_path / "other"
+    unscanned.mkdir()
+    (unscanned / "live.py").write_text(
+        "import random\nx = random.random()\n", encoding="utf-8")
+    (tmp_path / "baseline.json").write_text(json.dumps({"entries": [
+        {"rule": "DCR008", "path": "gone/deleted.py",
+         "snippet": "x = random.random()",
+         "justification": "fixture: file was deleted after grandfathering"},
+        {"rule": "DCR008", "path": "other/live.py",
+         "snippet": "x = random.random()",
+         "justification": "fixture: real finding in an unscanned file"},
+    ]}))
+    cfg = LintConfig(root=tmp_path, baseline="baseline.json")
+    report = scan([scanned], cfg)
+    # the deleted file's entry is flagged; the existing-but-unscanned file's
+    # entry is NOT (partial scans must not cry wolf about live files)
+    assert [e["path"] for e in report.stale_baseline] == ["gone/deleted.py"]
+
+
+# ---------------------------------------------------------------------------
 # 3. repo self-scan — what the static-analysis CI job enforces
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,23 @@
+"""dcr-check: whole-program static verification (``python -m tools.check``).
+
+Two layers on top of dcr-lint's file-local rules (tools/lint):
+
+- **Layer 1 — interprocedural lint** (tools/check/graph.py + rules.py):
+  an import graph + call graph over ``dcr_tpu/`` lifts the donation
+  (DCR002), RNG-reuse (DCR003) and unbounded-collective (DCR004) rules
+  across function and module boundaries, and adds DCR009 (untimed
+  ``Queue.get``/``Thread.join``/``Event.wait``/``Future.result`` on
+  serve/coordination hot paths) and DCR010 (jit entry point not registered
+  with ``@compile_surface``).
+- **Layer 2 — compile-surface manifest** (tools/check/surfaces.py +
+  manifest.py): every registered jit entry point is lowered under
+  representative configs — ``jax.jit(...).lower()`` only, no devices, no
+  execution — and fingerprinted (input avals, donated inputs, static-arg
+  values, lowered-HLO digest) into ``compile_manifest.json``. CI
+  regenerates the manifest and fails with a readable diff when a PR changes
+  a fingerprint or adds an unregistered entry point.
+
+Layer 1 is stdlib-only (runs on a bare checkout, like dcr-lint); layer 2
+imports jax and the product code. Exit codes match dcr-lint: 0 clean,
+1 findings/diffs, 2 configuration error.
+"""

@@ -1,0 +1,156 @@
+"""CLI: ``python -m tools.check [options]``.
+
+Default run = layer 1 (file-local dcr-lint + whole-program interprocedural
+rules) **and** layer 2 (regenerate the compile-surface manifest, diff it
+against the checked-in one). ``--no-manifest`` keeps it stdlib-only for the
+bare-checkout static-analysis CI job; ``--manifest-only`` is the
+compile-manifest CI job; ``--update-manifest`` rewrites the checked-in file
+after an intentional compile-surface change.
+
+Exit codes: 0 clean, 1 findings or manifest diff, 2 configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+from typing import Optional
+
+from tools.lint.engine import (LintError, github_annotation, parse_failures)
+
+from tools.check.config import load_check_config
+from tools.check.engine import CheckReport, run_layer1
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="dcr-check: whole-program static verification — "
+                    "interprocedural lint (layer 1) + compile-surface "
+                    "manifest (layer 2)")
+    p.add_argument("--format", choices=("human", "json", "github"),
+                   default="human")
+    p.add_argument("--no-manifest", action="store_true",
+                   help="layer 1 only (stdlib-only; no jax import)")
+    p.add_argument("--program-only", action="store_true",
+                   help="skip the file-local dcr-lint scan inside layer 1 — "
+                        "for CI jobs that already run `python -m tools.lint` "
+                        "as a separate step, so findings are not annotated "
+                        "twice")
+    p.add_argument("--manifest-only", action="store_true",
+                   help="layer 2 only: regenerate the manifest and diff it "
+                        "against the checked-in file")
+    p.add_argument("--update-manifest", action="store_true",
+                   help="regenerate and WRITE the checked-in manifest "
+                        "(commit the result)")
+    p.add_argument("--manifest", type=Path, default=None,
+                   help="manifest path override (default: "
+                        "[tool.dcr-check].manifest)")
+    p.add_argument("--config", type=Path, default=None,
+                   help="pyproject.toml to read [tool.dcr-check] from")
+    return p
+
+
+def _print_layer1(report: CheckReport, fmt: str) -> None:
+    if fmt == "json":
+        print(json.dumps(report.to_json(), indent=2))
+        return
+    if fmt == "github":
+        for f in report.findings:
+            print(github_annotation(f))
+        return
+    for f in report.findings:
+        print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
+    for entry in report.local.stale_baseline:
+        print(f"dcr-check: stale baseline entry (no longer matches): "
+              f"{entry['rule']} {entry['path']} — remove it",
+              file=sys.stderr)
+    counts = report.counts()
+    summary = ", ".join(f"{k}×{v}" for k, v in counts.items()) or "clean"
+    print(f"dcr-check: {len(report.findings)} finding"
+          f"{'' if len(report.findings) == 1 else 's'} ({summary}) in "
+          f"{report.local.files_scanned} files / "
+          f"{report.modules_analyzed} whole-program modules "
+          f"[suppressed: {report.local.baseline_suppressed} baseline, "
+          f"{report.local.pragma_suppressed + report.pragma_suppressed} "
+          "pragma]")
+
+
+def _run_manifest(cfg, manifest_path: Path, update: bool, fmt: str) -> int:
+    # import jax only here, after env defaults: the static layers must work
+    # on machines with no jax at all
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from tools.check.manifest import (build_manifest, diff_manifests,
+                                      load_manifest, write_manifest)
+    from tools.check.surfaces import generate_entries
+
+    quiet = fmt != "human"
+    log = (lambda *a, **k: None) if quiet else \
+        (lambda msg: print(msg, file=sys.stderr))
+    entries = generate_entries(log=log)
+    new = build_manifest(entries)
+    if update:
+        write_manifest(manifest_path, new)
+        print(f"dcr-check: wrote {len(entries)} compile-surface entries to "
+              f"{manifest_path}")
+        return 0
+    old = load_manifest(manifest_path)
+    diff = diff_manifests(old, new)
+    if not diff:
+        if fmt == "human":
+            print(f"dcr-check: compile manifest up to date "
+                  f"({len(entries)} entries, {manifest_path})")
+        return 0
+    if fmt == "github":
+        for line in diff:
+            msg = line.strip().replace("%", "%25").replace("\n", "%0A")
+            print(f"::error file={manifest_path.name},line=1,"
+                  f"title=compile-manifest::{msg}")
+    else:
+        print("dcr-check: compile-surface manifest DIFFERS from the "
+              "checked-in file — this PR changes a compile surface:")
+        for line in diff:
+            print(f"  {line}")
+        print("dcr-check: if intentional, run `python -m tools.check "
+              "--update-manifest` and commit the result")
+    return 1
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.no_manifest and (args.manifest_only or args.update_manifest):
+        print("dcr-check: error: --no-manifest conflicts with "
+              "--manifest-only/--update-manifest", file=sys.stderr)
+        return 2
+    try:
+        cfg = load_check_config(pyproject=args.config)
+        manifest_path = args.manifest or (cfg.root / cfg.manifest)
+        rc = 0
+        if not args.manifest_only and not args.update_manifest:
+            report = run_layer1(cfg, pyproject=args.config,
+                                manifest_path=manifest_path,
+                                include_local=not args.program_only)
+            _print_layer1(report, args.format)
+            broken = parse_failures(report.findings)
+            if broken:
+                for f in broken:
+                    print(f"dcr-check: error: {f.path}:{f.line}: "
+                          f"{f.message} — file could not be parsed; the "
+                          "scan is incomplete", file=sys.stderr)
+                return 2
+            rc = 1 if report.findings else 0
+        if not args.no_manifest:
+            mrc = _run_manifest(cfg, manifest_path, args.update_manifest,
+                                args.format)
+            rc = max(rc, mrc)
+        return rc
+    except LintError as e:
+        print(f"dcr-check: error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

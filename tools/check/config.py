@@ -1,0 +1,78 @@
+"""``[tool.dcr-check]`` configuration.
+
+Declared in pyproject.toml next to ``[tool.dcr-lint]``::
+
+    [tool.dcr-check]
+    roots = ["dcr_tpu"]                     # whole-program analysis scope
+    entry-modules = ["dcr_tpu/serve/worker.py", ...]   # DCR010 scope
+    hot-paths = ["dcr_tpu/serve/", ...]     # DCR009 scope (path prefixes)
+    manifest = "compile_manifest.json"      # checked-in fingerprint file
+
+Reuses the lint package's TOML reader so the 3.10 fallback parser and the
+"no pip install needed" property carry over.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.lint.config import _parse_toml, find_pyproject
+
+DEFAULT_ENTRY_MODULES = (
+    "dcr_tpu/diffusion/train.py",
+    "dcr_tpu/diffusion/trainer.py",
+    "dcr_tpu/serve/worker.py",
+    "dcr_tpu/sampling/sampler.py",
+    "dcr_tpu/eval/runner.py",
+    "dcr_tpu/eval/features.py",
+)
+DEFAULT_HOT_PATHS = (
+    "dcr_tpu/serve/",
+    "dcr_tpu/cli/serve.py",
+    "dcr_tpu/core/coordination.py",
+    "dcr_tpu/core/dist.py",
+)
+
+
+@dataclass
+class CheckConfig:
+    roots: tuple[str, ...] = ("dcr_tpu",)
+    entry_modules: tuple[str, ...] = DEFAULT_ENTRY_MODULES
+    hot_paths: tuple[str, ...] = DEFAULT_HOT_PATHS
+    manifest: str = "compile_manifest.json"
+    exclude: tuple[str, ...] = ("__pycache__",)
+    root: Path = field(default_factory=Path)
+
+    def in_hot_path(self, relpath: str) -> bool:
+        posix = relpath.replace("\\", "/")
+        for prefix in self.hot_paths:
+            p = prefix.rstrip("/")
+            if posix == p or posix.startswith(p + "/"):
+                return True
+        return False
+
+    def is_entry_module(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/") in set(self.entry_modules)
+
+
+def load_check_config(pyproject: Optional[Path] = None,
+                      start: Optional[Path] = None) -> CheckConfig:
+    if pyproject is None:
+        pyproject = find_pyproject(start or Path.cwd())
+    if pyproject is None or not pyproject.is_file():
+        return CheckConfig()
+    data = _parse_toml(pyproject.read_text(encoding="utf-8"))
+    section = data.get("tool", {}).get("dcr-check", {})
+    if not isinstance(section, dict):
+        section = {}
+    return CheckConfig(
+        roots=tuple(section.get("roots", ("dcr_tpu",))),
+        entry_modules=tuple(section.get("entry-modules",
+                                        DEFAULT_ENTRY_MODULES)),
+        hot_paths=tuple(section.get("hot-paths", DEFAULT_HOT_PATHS)),
+        manifest=section.get("manifest", "compile_manifest.json"),
+        exclude=tuple(section.get("exclude", ("__pycache__",))),
+        root=pyproject.parent,
+    )

@@ -1,0 +1,116 @@
+"""dcr-check scan driver: layer-1 orchestration + reporting.
+
+``scan_program`` runs the whole-program pass (interprocedural DCR002/3/4,
+DCR009 on hot paths, DCR010 + manifest coverage on entry modules) over the
+configured roots; ``run_layer1`` combines it with the full file-local
+dcr-lint scan so ``python -m tools.check`` subsumes ``python -m tools.lint``.
+
+Suppression: the same ``# dcr-lint: disable=DCR00x`` pragmas apply —
+interprocedural findings are filtered against the pragma on their reported
+line, so one escape hatch serves both layers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.lint.config import load_config as load_lint_config
+from tools.lint.engine import Report, _pragma_rules, scan
+from tools.lint.rules import Finding
+
+from tools.check.config import CheckConfig, load_check_config
+from tools.check.graph import ProgramIndex, load_program
+from tools.check.rules import (check_dcr009, check_dcr010,
+                               check_manifest_coverage, check_x002,
+                               check_x003, check_x004)
+
+LINT_PATHS = ("dcr_tpu", "tests", "tools")
+
+
+@dataclass
+class CheckReport:
+    local: Report                       # the file-local dcr-lint layer
+    program: list[Finding] = field(default_factory=list)
+    pragma_suppressed: int = 0
+    modules_analyzed: int = 0
+
+    @property
+    def findings(self) -> list[Finding]:
+        return self.local.findings + self.program
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_json(self) -> dict:
+        base = self.local.to_json()
+        base["program_findings"] = [
+            {"rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+             "message": f.message, "snippet": f.snippet}
+            for f in self.program
+        ]
+        base["counts"] = self.counts()
+        base["modules_analyzed"] = self.modules_analyzed
+        base["suppressed"]["pragma"] += self.pragma_suppressed
+        return base
+
+
+def scan_program(cfg: CheckConfig, *,
+                 manifest_path: Optional[Path] = None
+                 ) -> tuple[list[Finding], int, int]:
+    """(findings, pragma-suppressed, modules analyzed) for the whole-program
+    layer. Stdlib-only — safe on a bare checkout."""
+    index = load_program(cfg.root, cfg.roots, cfg.exclude)
+    raw: list[Finding] = []
+    for info in index.modules.values():
+        raw.extend(check_x002(index, info))
+        raw.extend(check_x003(index, info))
+        raw.extend(check_x004(index, info))
+        if cfg.in_hot_path(info.relpath):
+            raw.extend(check_dcr009(info))
+        raw.extend(check_dcr010(index, info, cfg))
+    mpath = manifest_path if manifest_path is not None \
+        else cfg.root / cfg.manifest
+    raw.extend(check_manifest_coverage(index, cfg, mpath))
+    raw = list(dict.fromkeys(raw))
+    kept: list[Finding] = []
+    suppressed = 0
+    by_path = {info.relpath: info for info in index.modules.values()}
+    for f in raw:
+        info = by_path.get(f.path)
+        line = info.analysis.line(f.line) if info is not None else ""
+        disabled = _pragma_rules(line)
+        if f.rule in disabled or "ALL" in disabled:
+            suppressed += 1
+        else:
+            kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return kept, suppressed, len(index.modules)
+
+
+def run_layer1(cfg: Optional[CheckConfig] = None, *,
+               pyproject: Optional[Path] = None,
+               lint_paths: tuple[str, ...] = LINT_PATHS,
+               manifest_path: Optional[Path] = None,
+               include_local: bool = True) -> CheckReport:
+    """Full static layer: file-local dcr-lint scan + whole-program pass.
+
+    ``include_local=False`` skips the file-local scan (the CLI's
+    ``--program-only``): in CI the dcr-lint step already reports those
+    findings with its own annotations, and re-reporting them here would
+    double every inline ::error on the PR diff."""
+    cfg = cfg or load_check_config(pyproject=pyproject)
+    if include_local:
+        lint_cfg = load_lint_config(pyproject=pyproject, start=cfg.root)
+        local = scan([cfg.root / p for p in lint_paths], lint_cfg)
+    else:
+        local = Report()
+    program, suppressed, n_modules = scan_program(
+        cfg, manifest_path=manifest_path)
+    return CheckReport(local=local, program=program,
+                       pragma_suppressed=suppressed,
+                       modules_analyzed=n_modules)

@@ -1,0 +1,386 @@
+"""Whole-program index: modules, call resolution, function summaries.
+
+The file-local :class:`tools.lint.analysis.ModuleAnalysis` stays the unit of
+parsing; this module stitches those per-file analyses into one program:
+
+- **module naming**: ``dcr_tpu/serve/worker.py`` -> ``dcr_tpu.serve.worker``
+  (``__init__.py`` -> the package name), with relative imports rebased onto
+  the absolute module name;
+- **call resolution**: a call expression in module M resolves — through M's
+  import aliases — to a top-level function def in any scanned module (or in
+  M itself). Method calls and attribute chains that don't land on a known
+  module stay unresolved; the interprocedural rules are precision-biased
+  and simply skip them;
+- **function summaries**, computed to a fixpoint over the call graph, carry
+  the three facts the cross-module rules need:
+
+  * ``consumes_key``: parameter indices the function consumes as raw PRNG
+    keys (a direct ``jax.random.*`` draw, or passing the parameter through
+    to a callee that consumes it) — deriving via ``split``/``fold_in``
+    does NOT count, matching the one-use-per-raw-key discipline;
+  * ``donate_argnums`` / ``returns_donating``: calling the function donates
+    these positional args' buffers / the function's return value is a
+    callable that donates them (``return jax.jit(f, donate_argnums=...)``,
+    the make_train_step shape);
+  * ``wrapper_timeout``: the function forwards one of its own parameters
+    into a collective's timeout slot — making it a *collective wrapper*
+    whose call sites must thread a real timeout.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Optional
+
+from tools.lint.analysis import FuncNode, JIT_WRAPPERS, ModuleAnalysis
+from tools.lint.engine import LintError
+from tools.lint.rules import (_BOUNDED_COLLECTIVES, _KEY_CONSUMERS,
+                              _KEY_PRODUCERS, _TIMEOUT_KWARGS, _consumed_key,
+                              _is_jax_random)
+
+
+# ---------------------------------------------------------------------------
+# module discovery
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ModuleInfo:
+    name: str                 # absolute dotted module name
+    relpath: str              # repo-relative posix path
+    analysis: ModuleAnalysis
+    # alias -> absolute dotted target, with relative imports rebased
+    aliases: dict[str, str] = field(default_factory=dict)
+
+    def resolve(self, dotted: str) -> str:
+        head, sep, rest = dotted.partition(".")
+        target = self.aliases.get(head)
+        if target is None:
+            return dotted
+        return f"{target}.{rest}" if rest else target
+
+    def resolve_call_name(self, call: ast.Call) -> Optional[str]:
+        d = self.analysis.dotted(call.func)
+        return self.resolve(d) if d else None
+
+
+def _module_name(relpath: str) -> str:
+    parts = relpath[:-len(".py")].replace("\\", "/").split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _rebase_aliases(info: ModuleInfo, tree: ast.Module) -> None:
+    """Start from the file-local alias table, then fix relative imports
+    (``from .queue import X`` inside dcr_tpu.serve.worker -> dcr_tpu.serve
+    .queue.X), which the file-local analysis cannot absolutize."""
+    info.aliases.update(info.analysis.aliases)
+    pkg_parts = info.name.split(".")[:-1]
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or not node.level:
+            continue
+        base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+        mod = ".".join(base + ([node.module] if node.module else []))
+        for a in node.names:
+            local = a.asname or a.name
+            info.aliases[local] = f"{mod}.{a.name}" if mod else a.name
+
+
+def load_program(root: Path, roots: tuple[str, ...],
+                 exclude: tuple[str, ...] = ("__pycache__",)) -> "ProgramIndex":
+    """Parse every ``*.py`` under the configured roots into a ProgramIndex."""
+    modules: dict[str, ModuleInfo] = {}
+    for top in roots:
+        base = root / top
+        if not base.exists():
+            raise LintError(f"dcr-check root does not exist: {base}")
+        files = [base] if base.is_file() else sorted(base.rglob("*.py"))
+        for f in files:
+            rel = f.relative_to(root).as_posix()
+            if any(part in exclude for part in rel.split("/")):
+                continue
+            try:
+                source = f.read_text(encoding="utf-8")
+            except UnicodeDecodeError as e:
+                raise LintError(f"{rel}: not valid UTF-8 ({e.reason}) — "
+                                "whole-program analysis is incomplete") from e
+            try:
+                tree = ast.parse(source)
+            except SyntaxError as e:
+                raise LintError(f"{rel}:{e.lineno}: syntax error: {e.msg} — "
+                                "whole-program analysis is incomplete") from e
+            analysis = ModuleAnalysis(tree, source, rel)
+            info = ModuleInfo(name=_module_name(rel), relpath=rel,
+                              analysis=analysis)
+            _rebase_aliases(info, tree)
+            modules[info.name] = info
+    return ProgramIndex(modules)
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+
+@dataclass
+class WrapperTimeout:
+    """fn forwards parameter ``param_name`` (positional index ``param_index``,
+    -1 when keyword-only) into the timeout slot of ``target``."""
+
+    param_index: int
+    param_name: str
+    unbounded_default: bool    # default is 0/None — omitting it hangs
+    has_default: bool
+    target: str                # collective (or wrapper) being wrapped
+
+
+@dataclass
+class FnSummary:
+    module: str
+    name: str
+    node: ast.AST
+    params: list[str] = field(default_factory=list)       # positional order
+    kwonly: list[str] = field(default_factory=list)
+    consumes_key: set[int] = field(default_factory=set)
+    donate_argnums: tuple[int, ...] = ()
+    returns_donating: tuple[int, ...] = ()
+    wrapper_timeout: Optional[WrapperTimeout] = None
+
+
+def _is_unbounded_const(node: Optional[ast.AST]) -> bool:
+    return (isinstance(node, ast.Constant)
+            and (node.value is None or node.value in (0, 0.0)))
+
+
+def dotted_chain(node: ast.AST) -> Optional[str]:
+    """``self.step_fn`` -> "self.step_fn"; bare names pass through. Calls,
+    subscripts and anything dynamic return None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class ProgramIndex:
+    def __init__(self, modules: dict[str, ModuleInfo]):
+        self.modules = modules
+        # (module, func) -> def node, top-level functions only: the only
+        # targets the name-based resolver can hit without type inference
+        self.functions: dict[tuple[str, str], ast.AST] = {}
+        for info in modules.values():
+            for stmt in info.analysis.tree.body:
+                if isinstance(stmt, FuncNode):
+                    self.functions[(info.name, stmt.name)] = stmt
+        self.summaries: dict[tuple[str, str], FnSummary] = {
+            key: self._base_summary(key) for key in self.functions
+        }
+        self._fixpoint()
+
+    # -- call resolution -----------------------------------------------------
+
+    def resolve_call(self, info: ModuleInfo,
+                     call: ast.Call) -> Optional[tuple[str, str]]:
+        resolved = info.resolve_call_name(call)
+        if resolved is None:
+            return None
+        parts = resolved.split(".")
+        if len(parts) == 1:
+            key = (info.name, parts[0])
+            return key if key in self.functions else None
+        for i in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:i])
+            if mod in self.modules:
+                if i == len(parts) - 1:
+                    key = (mod, parts[-1])
+                    return key if key in self.functions else None
+                return None  # module.Class.method etc. — out of reach
+        return None
+
+    def summary_for_call(self, info: ModuleInfo,
+                         call: ast.Call) -> Optional[FnSummary]:
+        key = self.resolve_call(info, call)
+        return self.summaries.get(key) if key is not None else None
+
+    # -- summary computation ---------------------------------------------------
+
+    def _base_summary(self, key: tuple[str, str]) -> FnSummary:
+        mod, name = key
+        node = self.functions[key]
+        a = node.args
+        s = FnSummary(module=mod, name=name, node=node,
+                      params=[x.arg for x in (a.posonlyargs + a.args)],
+                      kwonly=[x.arg for x in a.kwonlyargs])
+        info = self.modules[mod]
+        jit_info = info.analysis.jit_infos.get(node)
+        if jit_info is not None and (jit_info.donate_argnums
+                                     or jit_info.donate_argnames):
+            s.donate_argnums = info.analysis._donate_indices(node, jit_info)
+        return s
+
+    def _param_default(self, node: ast.AST, pname: str) -> tuple[bool, Optional[ast.AST]]:
+        """(has_default, default node) for a positional-or-kw/kwonly param."""
+        a = node.args
+        pos = a.posonlyargs + a.args
+        names = [x.arg for x in pos]
+        if pname in names:
+            i = names.index(pname)
+            n_no_default = len(pos) - len(a.defaults)
+            if i >= n_no_default:
+                return True, a.defaults[i - n_no_default]
+            return False, None
+        if pname in [x.arg for x in a.kwonlyargs]:
+            d = a.kw_defaults[[x.arg for x in a.kwonlyargs].index(pname)]
+            return d is not None, d
+        return False, None
+
+    def _body_calls(self, node: ast.AST):
+        for stmt in node.body:
+            yield from ModuleAnalysis.deep_calls(stmt)
+
+    def _arg_param_pairs(self, call: ast.Call, caller: FnSummary,
+                         callee: FnSummary):
+        """(caller param index, callee param index) for every argument that
+        is a bare caller-parameter name passed positionally or by keyword."""
+        for j, arg in enumerate(call.args):
+            if isinstance(arg, ast.Name) and arg.id in caller.params:
+                if j < len(callee.params):
+                    yield caller.params.index(arg.id), j
+        for kw in call.keywords:
+            if kw.arg is None or not isinstance(kw.value, ast.Name):
+                continue
+            if kw.value.id in caller.params and kw.arg in callee.params:
+                yield (caller.params.index(kw.value.id),
+                       callee.params.index(kw.arg))
+
+    def _update_consumes(self, key: tuple[str, str]) -> bool:
+        s = self.summaries[key]
+        info = self.modules[key[0]]
+        analysis = info.analysis
+        before = set(s.consumes_key)
+        for call in self._body_calls(s.node):
+            if _is_jax_random(analysis, call, _KEY_CONSUMERS) is not None:
+                name = _consumed_key(call)
+                if name in s.params:
+                    s.consumes_key.add(s.params.index(name))
+                continue
+            callee = self.summary_for_call(info, call)
+            if callee is None or not callee.consumes_key:
+                continue
+            for ci, cj in self._arg_param_pairs(call, s, callee):
+                if cj in callee.consumes_key:
+                    s.consumes_key.add(ci)
+        return s.consumes_key != before
+
+    def _returned_donation(self, key: tuple[str, str]) -> tuple[int, ...]:
+        """donate_argnums of the callable this function returns, if any."""
+        s = self.summaries[key]
+        info = self.modules[key[0]]
+        analysis = info.analysis
+        local_donated = analysis.donated_callables.get(id(s.node), {})
+        for stmt in _walk_skip_defs(s.node):
+            if not isinstance(stmt, ast.Return) or stmt.value is None:
+                continue
+            v = stmt.value
+            if isinstance(v, ast.Call):
+                resolved = info.resolve_call_name(v)
+                if resolved in JIT_WRAPPERS and v.args:
+                    nums = _jit_donate_indices(analysis, v)
+                    if nums:
+                        return nums
+                callee = self.summary_for_call(info, v)
+                if callee is not None and callee.returns_donating:
+                    return callee.returns_donating
+            elif isinstance(v, ast.Name) and v.id in local_donated:
+                return local_donated[v.id]
+        return ()
+
+    def _update_wrapper(self, key: tuple[str, str]) -> bool:
+        s = self.summaries[key]
+        if s.wrapper_timeout is not None:
+            return False
+        info = self.modules[key[0]]
+        analysis = info.analysis
+        for call in self._body_calls(s.node):
+            last = analysis.last_segment(call.func)
+            timeout_expr: Optional[ast.AST] = None
+            target = None
+            if last in _BOUNDED_COLLECTIVES:
+                pos = _BOUNDED_COLLECTIVES[last]
+                if len(call.args) > pos:
+                    timeout_expr = call.args[pos]
+                for kw in call.keywords:
+                    if kw.arg in _TIMEOUT_KWARGS:
+                        timeout_expr = kw.value
+                target = last
+            else:
+                callee = self.summary_for_call(info, call)
+                if callee is None or callee.wrapper_timeout is None:
+                    continue
+                wt = callee.wrapper_timeout
+                if 0 <= wt.param_index < len(call.args):
+                    timeout_expr = call.args[wt.param_index]
+                for kw in call.keywords:
+                    if kw.arg == wt.param_name:
+                        timeout_expr = kw.value
+                target = f"{callee.name}() -> {wt.target}"
+            if not isinstance(timeout_expr, ast.Name):
+                continue
+            pname = timeout_expr.id
+            if pname in s.params or pname in s.kwonly:
+                has_default, default = self._param_default(s.node, pname)
+                s.wrapper_timeout = WrapperTimeout(
+                    param_index=(s.params.index(pname)
+                                 if pname in s.params else -1),
+                    param_name=pname,
+                    unbounded_default=has_default and _is_unbounded_const(default),
+                    has_default=has_default,
+                    target=target or "collective")
+                return True
+        return False
+
+    def _fixpoint(self) -> None:
+        # summaries feed each other (pass-through key consumption, wrapper-of-
+        # wrapper, returned donating callables); the lattice only grows, so
+        # iterate until stable with a hard bound for safety
+        for _ in range(len(self.functions) + 2):
+            changed = False
+            for key in self.functions:
+                changed |= self._update_consumes(key)
+                changed |= self._update_wrapper(key)
+                ret = self._returned_donation(key)
+                if ret and ret != self.summaries[key].returns_donating:
+                    self.summaries[key].returns_donating = ret
+                    changed = True
+            if not changed:
+                break
+
+
+def _walk_skip_defs(fn: ast.AST):
+    """Every node in fn's own body, excluding nested function/lambda bodies
+    (a nested def's ``return`` is not this function's return)."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _jit_donate_indices(analysis: ModuleAnalysis,
+                        jit_call: ast.Call) -> tuple[int, ...]:
+    """donate_argnums/argnames of a ``jax.jit(f, ...)`` call expression,
+    argnames folded into indices through f's def when resolvable."""
+    info = analysis._jit_kwargs(jit_call)
+    if not (info.donate_argnums or info.donate_argnames):
+        return ()
+    first = jit_call.args[0]
+    if isinstance(first, ast.Name):
+        for d in analysis.defs_by_name.get(first.id, []):
+            return analysis._donate_indices(d, info)
+    return tuple(sorted(info.donate_argnums))

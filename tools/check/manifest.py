@@ -1,0 +1,189 @@
+"""Compile-surface manifest: fingerprint, serialize, diff.
+
+Each registered surface variant is lowered — ``jax.jit(...).lower(*avals)``
+only; no devices are touched and nothing executes — and reduced to a
+fingerprint with exactly the fields whose change means "this PR introduces
+a recompile / changes serve bucket shapes / changes donation":
+
+- ``in_avals`` / ``out_avals``: flattened shape/dtype (and sharding, when
+  present) of the program's inputs and outputs, digested; full per-leaf
+  detail is kept for small trees so diffs read like a shape report;
+- ``donated_inputs``: how many flattened inputs the lowering marks as
+  donated (``tf.aliasing_output`` in the StableHLO), next to the
+  spec-declared ``donate_argnums``;
+- ``static_config``: the closure-static values (steps, guidance, sampler,
+  resolution, batch…) the builder baked into the program — a changed
+  static arg is a changed program even when every aval matches;
+- ``lowered_sha256``: digest of the full StableHLO text — the catch-all
+  for structural changes. Compared only when the recorded jax version
+  matches, so a toolchain bump doesn't read as a product regression.
+
+The CI contract: ``python -m tools.check --manifest-only`` regenerates the
+manifest on a fresh checkout and fails with a readable per-field diff when
+it disagrees with the checked-in ``compile_manifest.json``;
+``--update-manifest`` rewrites the file after an intentional change.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+MANIFEST_VERSION = 1
+DETAIL_LEAVES = 24   # trees up to this many leaves keep per-leaf detail
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()
+
+
+def describe_avals(tree: Any) -> dict:
+    """Digestible description of a pytree of avals/arrays."""
+    import jax
+
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    lines = []
+    for path, leaf in leaves_with_path:
+        keystr = jax.tree_util.keystr(path) or "."
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        shape = tuple(getattr(leaf, "shape", ()))
+        sharding = getattr(leaf, "sharding", None)
+        desc = f"{keystr}: {dtype}{list(shape)}"
+        if sharding is not None:
+            desc += f" @ {sharding}"
+        lines.append(desc)
+    lines.sort()
+    out = {"leaves": len(lines), "digest": _sha("\n".join(lines))[:16]}
+    out["detail"] = lines if len(lines) <= DETAIL_LEAVES \
+        else lines[:4] + [f"... ({len(lines) - 4} more leaves)"]
+    return out
+
+
+def fingerprint(name: str, fn, args: tuple, *, static_config: dict,
+                donate_argnums: tuple = (), surface: str = "",
+                variant: str = "default") -> dict:
+    """Lower ``fn(*args)`` (abstract: no devices, no execution) and reduce
+    it to one manifest entry."""
+    import jax
+
+    lowered = fn.lower(*args)
+    text = lowered.as_text()
+    out_info = getattr(lowered, "out_info", None)
+    if out_info is None:
+        out_info = jax.eval_shape(fn, *args)
+    return {
+        "surface": surface or name,
+        "variant": variant,
+        "static_config": dict(sorted(static_config.items())),
+        "donate_argnums": sorted(int(i) for i in donate_argnums),
+        "donated_inputs": text.count("tf.aliasing_output"),
+        "in_avals": describe_avals(args),
+        "out_avals": describe_avals(out_info),
+        "lowered_sha256": _sha(text),
+    }
+
+
+def build_manifest(entries: dict[str, dict]) -> dict:
+    import jax
+
+    return {
+        "version": MANIFEST_VERSION,
+        "jax_version": jax.__version__,
+        "comment": ("dcr-check compile-surface manifest: static fingerprints "
+                    "of every registered jit entry point under "
+                    "representative configs. Regenerate with `python -m "
+                    "tools.check --update-manifest` after an INTENTIONAL "
+                    "compile-surface change; CI fails on any unexplained "
+                    "diff."),
+        "entries": {k: entries[k] for k in sorted(entries)},
+    }
+
+
+def write_manifest(path: Path, manifest: dict) -> None:
+    path.write_text(json.dumps(manifest, indent=2, sort_keys=False) + "\n",
+                    encoding="utf-8")
+
+
+def load_manifest(path: Path) -> Optional[dict]:
+    if not path.is_file():
+        return None
+    return json.loads(path.read_text(encoding="utf-8"))
+
+
+# ---------------------------------------------------------------------------
+# diff
+# ---------------------------------------------------------------------------
+
+def _diff_avals(prefix: str, old: dict, new: dict, lines: list[str]) -> None:
+    if old.get("digest") == new.get("digest"):
+        return
+    lines.append(f"  {prefix}: {old.get('leaves')} leaves "
+                 f"[{old.get('digest')}] -> {new.get('leaves')} leaves "
+                 f"[{new.get('digest')}]")
+    old_detail = set(old.get("detail", []))
+    new_detail = set(new.get("detail", []))
+    for gone in sorted(old_detail - new_detail)[:8]:
+        lines.append(f"    - {gone}")
+    for added in sorted(new_detail - old_detail)[:8]:
+        lines.append(f"    + {added}")
+
+
+def diff_manifests(old: Optional[dict], new: dict) -> list[str]:
+    """Human-readable difference report; empty means the compile surface is
+    unchanged. Every line names the entry and the field so the CI failure
+    reads as 'what recompiles and why'."""
+    if old is None:
+        return [f"no checked-in manifest — {len(new['entries'])} entries "
+                "would be created (run --update-manifest and commit)"]
+    lines: list[str] = []
+    old_entries = old.get("entries", {})
+    new_entries = new.get("entries", {})
+    same_jax = old.get("jax_version") == new.get("jax_version")
+    for key in sorted(set(old_entries) - set(new_entries)):
+        lines.append(f"{key}: entry removed — this jit entry point is no "
+                     "longer registered/built (intentional? run "
+                     "--update-manifest)")
+    for key in sorted(set(new_entries) - set(old_entries)):
+        lines.append(f"{key}: NEW entry point — not in the checked-in "
+                     "manifest (a new compile surface; run "
+                     "--update-manifest to accept it)")
+    for key in sorted(set(old_entries) & set(new_entries)):
+        o, n = old_entries[key], new_entries[key]
+        entry_lines: list[str] = []
+        os_, ns_ = o.get("static_config", {}), n.get("static_config", {})
+        for k in sorted(set(os_) | set(ns_)):
+            if os_.get(k) != ns_.get(k):
+                entry_lines.append(
+                    f"  static_config.{k}: {os_.get(k)!r} -> {ns_.get(k)!r} "
+                    "(a changed static arg recompiles every cached program "
+                    "for this surface)")
+        if o.get("donate_argnums") != n.get("donate_argnums"):
+            entry_lines.append(
+                f"  donate_argnums: {o.get('donate_argnums')} -> "
+                f"{n.get('donate_argnums')} (callers' buffer lifetimes "
+                "change — audit every call site for use-after-donation)")
+        if o.get("donated_inputs") != n.get("donated_inputs"):
+            entry_lines.append(
+                f"  donated_inputs: {o.get('donated_inputs')} -> "
+                f"{n.get('donated_inputs')} flattened inputs donated")
+        _diff_avals("in_avals", o.get("in_avals", {}), n.get("in_avals", {}),
+                    entry_lines)
+        _diff_avals("out_avals", o.get("out_avals", {}),
+                    n.get("out_avals", {}), entry_lines)
+        if not entry_lines and same_jax and \
+                o.get("lowered_sha256") != n.get("lowered_sha256"):
+            entry_lines.append(
+                "  lowered HLO changed (same shapes/statics/donation — a "
+                "structural change inside the program; expected for any "
+                "edit to the surface's compute, but verify it was "
+                "intentional)")
+        if entry_lines:
+            lines.append(f"{key}:")
+            lines.extend(entry_lines)
+    if lines and not same_jax:
+        lines.append(f"note: recorded jax {old.get('jax_version')} vs "
+                     f"current {new.get('jax_version')} — HLO digests were "
+                     "not compared")
+    return lines

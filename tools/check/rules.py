@@ -1,0 +1,528 @@
+"""dcr-check whole-program rules.
+
+The interprocedural lifts of DCR002/DCR003/DCR004 report under the SAME rule
+ids as their file-local counterparts (one id per hazard class; the pragma
+``# dcr-lint: disable=DCR00x`` works for both layers), but only emit
+findings the file-local rules *cannot* see — a fact that crossed a function
+or module boundary is always involved, so the two layers never double-report
+one hazard.
+
+DCR009 and DCR010 are new, whole-program-only rules:
+
+- **DCR009** — blocking waits without a deadline (``Queue.get``,
+  ``Thread.join``, ``Event.wait``, ``Condition.wait[_for]``,
+  ``Future.result``) on the configured serve/coordination hot paths. The
+  hang watchdog catches these at runtime (exit 89); this catches them at
+  review time.
+- **DCR010** — a jit entry point in a configured entry module that is not
+  registered with ``@compile_surface``, or a registered surface missing
+  from the checked-in compile manifest. Unregistered entry points are
+  invisible to the compile-surface manifest, so a PR could add recompiles
+  CI never fingerprints.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from pathlib import Path
+from typing import Iterator, Optional
+
+from tools.lint.analysis import (FuncNode, ModuleAnalysis, _walk_shallow,
+                                 enclosing_loop)
+from tools.lint.rules import (Finding, _BOUNDED_COLLECTIVES, _KEY_CONSUMERS,
+                              _KEY_PRODUCERS, _consumed_key, _is_jax_random,
+                              _param_key_names, _under_run_with_timeout)
+
+from tools.check.config import CheckConfig
+from tools.check.graph import (ModuleInfo, ProgramIndex, _is_unbounded_const,
+                               dotted_chain)
+
+
+def _finding(info: ModuleInfo, rule: str, node: ast.AST, message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    return Finding(rule=rule, path=info.relpath, line=line,
+                   col=getattr(node, "col_offset", 0), message=message,
+                   snippet=info.analysis.line(line).strip())
+
+
+def _chains(stmt: ast.stmt, ctx_type) -> set[str]:
+    """Dotted chains (names and self.x.y attribute paths) in the given
+    expression context, shallow (no nested def/lambda bodies, no compound-
+    statement bodies)."""
+    out: set[str] = set()
+    for node in _walk_shallow(stmt):
+        if isinstance(node, (ast.Name, ast.Attribute)) and \
+                isinstance(node.ctx, ctx_type):
+            c = dotted_chain(node)
+            if c is not None:
+                out.add(c)
+    return out
+
+
+def _scope_walk(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Every node under the scope's own statements, excluding nested
+    function/lambda bodies (those are separate scopes)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# ---------------------------------------------------------------------------
+# DCR002 — interprocedural donation-after-use
+# ---------------------------------------------------------------------------
+
+def _donating_callables(index: ProgramIndex, info: ModuleInfo,
+                        body: list[ast.stmt]
+                        ) -> dict[str, tuple[tuple[int, ...], str]]:
+    """chain -> (donate indices, provenance) for callables whose donation the
+    file-local rule cannot see: names/attr chains bound in this scope to the
+    result of a donating-*builder* call (a local or imported
+    ``make_train_step``-style function that returns ``jax.jit(...,
+    donate_argnums=...)``)."""
+    out: dict[str, tuple[tuple[int, ...], str]] = {}
+    for node in _scope_walk(body):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        s = index.summary_for_call(info, value)
+        if s is None or not s.returns_donating:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            c = dotted_chain(t)
+            if c is not None:
+                out[c] = (s.returns_donating,
+                          f"the callable built by {s.module}.{s.name}()")
+    return out
+
+
+def _class_donating_attrs(index: ProgramIndex, info: ModuleInfo,
+                          cls: ast.ClassDef
+                          ) -> dict[str, tuple[tuple[int, ...], str]]:
+    """``self.<attr>`` chains any method of ``cls`` binds to a donating-
+    builder result — visible from every other method of the class (the
+    ``self.step_fn = make_train_step(...)`` in ``__init__`` /
+    ``self.step_fn(self.state, ...)`` in the loop shape)."""
+    out: dict[str, tuple[tuple[int, ...], str]] = {}
+    for method in cls.body:
+        if not isinstance(method, FuncNode):
+            continue
+        out.update(_donating_callables(index, info, method.body))
+    return {c: v for c, v in out.items() if c.startswith("self.")}
+
+
+def _rebound_in_loop(analysis: ModuleAnalysis, body: list[ast.stmt],
+                     stmt: ast.stmt, arg_chain: str) -> bool:
+    """True when the donated chain is stored by ANY statement of the
+    enclosing loop's body (or is the loop target itself) — the binding is
+    fresh again before the donating call's next iteration, so only truly
+    un-rebound donation is a hazard."""
+    loop = enclosing_loop(body, stmt)
+    if loop is None:
+        return False
+    if arg_chain in _chains(loop, ast.Store):
+        return True  # the for-loop target rebinds every iteration
+    return any(arg_chain in _chains(inner.stmt, ast.Store)
+               for inner in analysis.linearize(loop.body, 1)
+               if inner.stmt is not stmt)
+
+
+def check_x002(index: ProgramIndex, info: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    analysis = info.analysis
+    class_of: dict[int, ast.ClassDef] = {}
+    for node in ast.walk(analysis.tree):
+        if isinstance(node, ast.ClassDef):
+            for method in node.body:
+                if isinstance(method, FuncNode):
+                    class_of[id(method)] = node
+    class_attr_cache: dict[int, dict] = {}
+    for scope, body in analysis.scopes():
+        donated = _donating_callables(index, info, body)
+        cls = class_of.get(id(scope))
+        if cls is not None:
+            if id(cls) not in class_attr_cache:
+                class_attr_cache[id(cls)] = _class_donating_attrs(index, info, cls)
+            donated = {**class_attr_cache[id(cls)], **donated}
+        stmts = list(analysis.linearize(body))
+        for i, ls in enumerate(stmts):
+            for call in analysis.stmt_calls(ls.stmt):
+                chain = dotted_chain(call.func)
+                indices: tuple[int, ...] = ()
+                provenance = ""
+                if chain is not None and chain in donated:
+                    indices, provenance = donated[chain]
+                else:
+                    # a direct call to an imported jitted-with-donation fn
+                    # (the file-local rule only sees same-module donation)
+                    s = index.summary_for_call(info, call)
+                    if s is not None and s.donate_argnums and \
+                            s.module != info.name:
+                        indices = s.donate_argnums
+                        provenance = (f"{s.module}.{s.name} is jitted with "
+                                      "donate_argnums")
+                        chain = dotted_chain(call.func)
+                if not indices or chain is None:
+                    continue
+                for k in indices:
+                    if k >= len(call.args):
+                        continue
+                    arg_chain = dotted_chain(call.args[k])
+                    if arg_chain is None:
+                        continue
+                    bound = _chains(ls.stmt, ast.Store)
+                    if arg_chain in bound:
+                        continue  # x, ... = f(x, ...) — rebound in place
+                    if ls.loop_depth > 0:
+                        if _rebound_in_loop(analysis, body, ls.stmt,
+                                            arg_chain):
+                            continue  # fresh again before the next iteration
+                        out.append(_finding(
+                            info, "DCR002", call,
+                            f"'{arg_chain}' is donated to {chain}() — "
+                            f"{provenance} — inside a loop but never "
+                            "rebound: the next iteration passes a buffer "
+                            "XLA already freed"))
+                        continue
+                    for later in stmts[i + 1:]:
+                        if later.exclusive_with(ls):
+                            continue
+                        loaded = _chains(later.stmt, ast.Load)
+                        if any(l == arg_chain or l.startswith(arg_chain + ".")
+                               for l in loaded):
+                            out.append(_finding(
+                                info, "DCR002", later.stmt,
+                                f"'{arg_chain}' is read after being donated "
+                                f"to {chain}() on line {call.lineno} — "
+                                f"{provenance} frees/aliases that buffer; "
+                                "read it before the call or rebind the "
+                                "result over it"))
+                            break
+                        if arg_chain in _chains(later.stmt, ast.Store):
+                            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR003 — interprocedural RNG key reuse
+# ---------------------------------------------------------------------------
+
+def check_x003(index: ProgramIndex, info: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    analysis = info.analysis
+    for scope, body in analysis.scopes():
+        key_depth: dict[str, int] = {p: 0 for p in _param_key_names(scope)}
+        consumed: dict[str, tuple] = {}     # name -> (LinearStmt, line, via)
+        for ls in analysis.linearize(body):
+            for call in analysis.stmt_calls(ls.stmt):
+                via: Optional[str] = None
+                name: Optional[str] = None
+                if _is_jax_random(analysis, call, _KEY_CONSUMERS) is not None:
+                    name = _consumed_key(call)
+                else:
+                    callee = index.summary_for_call(info, call)
+                    if callee is not None and callee.consumes_key:
+                        for j, arg in enumerate(call.args):
+                            if j in callee.consumes_key and \
+                                    isinstance(arg, ast.Name):
+                                name = arg.id
+                                via = f"{callee.module}.{callee.name}()"
+                                break
+                        if name is None:
+                            for kw in call.keywords:
+                                if kw.arg in callee.params and \
+                                        callee.params.index(kw.arg) in \
+                                        callee.consumes_key and \
+                                        isinstance(kw.value, ast.Name):
+                                    name = kw.value.id
+                                    via = f"{callee.module}.{callee.name}()"
+                                    break
+                if name is None or name not in key_depth:
+                    continue
+                prev = consumed.get(name)
+                if prev is not None and not prev[0].exclusive_with(ls):
+                    # only report when a callee is involved on either side:
+                    # two raw jax.random draws are the file-local rule's case
+                    if via is not None or prev[2] is not None:
+                        first_via = prev[2] or "a jax.random draw"
+                        this_via = via or "a jax.random draw"
+                        out.append(_finding(
+                            info, "DCR003", call,
+                            f"RNG key '{name}' is consumed by {this_via} "
+                            f"after already being consumed by {first_via} "
+                            f"on line {prev[1]} without split/fold_in — the "
+                            "callee draws from the same key, so both sites "
+                            "see identical randomness"))
+                    continue
+                if via is not None and ls.loop_depth > key_depth.get(name, 0):
+                    out.append(_finding(
+                        info, "DCR003", call,
+                        f"RNG key '{name}' (bound outside this loop) is "
+                        f"consumed by {via} every iteration — every call "
+                        "draws identical randomness; fold_in the loop index "
+                        "or split per iteration"))
+                    continue
+                consumed[name] = (ls, call.lineno, via)
+            bound = analysis.bound_names(ls.stmt)
+            for n in bound:
+                consumed.pop(n, None)
+            for call in analysis.stmt_calls(ls.stmt):
+                if _is_jax_random(analysis, call, _KEY_PRODUCERS) is not None:
+                    for n in bound:
+                        key_depth[n] = ls.loop_depth
+                    break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR004 — collective wrappers that drop the timeout
+# ---------------------------------------------------------------------------
+
+def check_x004(index: ProgramIndex, info: ModuleInfo) -> list[Finding]:
+    out: list[Finding] = []
+    analysis = info.analysis
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = analysis.last_segment(node.func)
+        if last in _BOUNDED_COLLECTIVES:
+            continue  # the file-local rule owns direct collective calls
+        callee = index.summary_for_call(info, node)
+        if callee is None or callee.wrapper_timeout is None:
+            continue
+        wt = callee.wrapper_timeout
+        timeout_expr: Optional[ast.AST] = None
+        present = False
+        if 0 <= wt.param_index < len(node.args):
+            timeout_expr = node.args[wt.param_index]
+            present = True
+        for kw in node.keywords:
+            if kw.arg == wt.param_name:
+                timeout_expr = kw.value
+                present = True
+        where = f"{callee.module}.{callee.name}"
+        if not present:
+            if wt.unbounded_default and not _under_run_with_timeout(analysis, node):
+                out.append(_finding(
+                    info, "DCR004", node,
+                    f"{callee.name}() wraps {wt.target} and defaults "
+                    f"{wt.param_name} to no deadline — a dead peer hangs the "
+                    f"pod here forever; pass {wt.param_name} at this call "
+                    f"site (wrapper: {where})"))
+            continue
+        if _is_unbounded_const(timeout_expr) and \
+                not _under_run_with_timeout(analysis, node):
+            out.append(_finding(
+                info, "DCR004", node,
+                f"{callee.name}() threads {wt.param_name} into {wt.target}, "
+                "but this call site passes no deadline (0/None) — the "
+                "collective inside the helper can hang the pod; pass a "
+                f"real {wt.param_name}"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR009 — untimed blocking waits on hot paths
+# ---------------------------------------------------------------------------
+
+# constructor -> (blocking method, how timeouts are passed)
+_SYNC_CONSTRUCTORS = {
+    "queue.Queue": "get",
+    "queue.LifoQueue": "get",
+    "queue.PriorityQueue": "get",
+    "queue.SimpleQueue": "get",
+    "multiprocessing.Queue": "get",
+    "threading.Event": "wait",
+    "threading.Condition": "wait",
+    "threading.Barrier": "wait",
+    "threading.Thread": "join",
+}
+_FUTURE_RECEIVERS = {"future", "fut"}
+
+
+def _bounded_wait(call: ast.Call, method: str) -> bool:
+    """True when this get/join/wait/result call carries a deadline (or is
+    explicitly non-blocking)."""
+    kwargs = {kw.arg: kw.value for kw in call.keywords}
+    if "timeout" in kwargs:
+        return not _is_unbounded_const(kwargs["timeout"])
+    if method == "get":
+        # Queue.get(block, timeout): nonblocking get(False) is bounded;
+        # get(True, t) is bounded by t
+        if "block" in kwargs and isinstance(kwargs["block"], ast.Constant) \
+                and kwargs["block"].value is False:
+            return True
+        if call.args and isinstance(call.args[0], ast.Constant) \
+                and call.args[0].value is False:
+            return True
+        return len(call.args) >= 2 and not _is_unbounded_const(call.args[1])
+    if method == "wait_for":
+        # Condition.wait_for(predicate, timeout)
+        return len(call.args) >= 2 and not _is_unbounded_const(call.args[1])
+    # wait(timeout) / join(timeout) / result(timeout)
+    return len(call.args) >= 1 and not _is_unbounded_const(call.args[0])
+
+
+def check_dcr009(info: ModuleInfo) -> list[Finding]:
+    analysis = info.analysis
+    # chains bound (anywhere in the module — __init__ vs worker-loop methods)
+    # to a Queue/Event/Thread/Condition/Barrier constructor result
+    tracked: dict[str, str] = {}
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        d = analysis.dotted(value.func)
+        resolved = info.resolve(d) if d else None
+        method = _SYNC_CONSTRUCTORS.get(resolved or "")
+        if method is None:
+            continue
+        targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+        for t in targets:
+            c = dotted_chain(t)
+            if c is not None:
+                tracked[c] = method
+    out: list[Finding] = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        attr = node.func.attr
+        recv = dotted_chain(node.func.value)
+        flagged: Optional[str] = None
+        if recv is not None and tracked.get(recv) is not None:
+            expect = tracked[recv]
+            if attr == expect or (expect == "wait" and attr == "wait_for"):
+                if not _bounded_wait(node, attr):
+                    flagged = f"{recv}.{attr}()"
+        elif attr == "result" and recv is not None and \
+                recv.split(".")[-1] in _FUTURE_RECEIVERS:
+            if not _bounded_wait(node, attr):
+                flagged = f"{recv}.result()"
+        if flagged:
+            out.append(_finding(
+                info, "DCR009", node,
+                f"{flagged} without a timeout on a serve/coordination hot "
+                "path — a wedged producer turns this into a silent hang the "
+                "watchdog can only catch at runtime; pass a timeout and "
+                "handle the expiry (retry, shed, or abort with a typed "
+                "error)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR010 — unregistered jit entry points / stale manifest registration
+# ---------------------------------------------------------------------------
+
+def _surface_decorations(analysis: ModuleAnalysis) -> dict[int, tuple[str, bool]]:
+    """id(def node) -> (surface name, manifest flag) for every function
+    decorated with @compile_surface("name", ...)."""
+    out: dict[int, tuple[str, bool]] = {}
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, FuncNode):
+            continue
+        for dec in node.decorator_list:
+            if not isinstance(dec, ast.Call):
+                continue
+            if analysis.last_segment(dec.func) != "compile_surface":
+                continue
+            if not dec.args or not isinstance(dec.args[0], ast.Constant):
+                continue
+            manifest = True
+            for kw in dec.keywords:
+                if kw.arg == "manifest" and isinstance(kw.value, ast.Constant):
+                    manifest = bool(kw.value.value)
+            out[id(node)] = (str(dec.args[0].value), manifest)
+    return out
+
+
+def registered_surfaces(index: ProgramIndex,
+                        cfg: CheckConfig) -> dict[str, bool]:
+    """surface name -> manifest flag, parsed statically from the entry
+    modules (no product import needed)."""
+    out: dict[str, bool] = {}
+    for info in index.modules.values():
+        if not cfg.is_entry_module(info.relpath):
+            continue
+        for name, manifest in _surface_decorations(info.analysis).values():
+            out[name] = manifest
+    return out
+
+
+def check_dcr010(index: ProgramIndex, info: ModuleInfo,
+                 cfg: CheckConfig) -> list[Finding]:
+    if not cfg.is_entry_module(info.relpath):
+        return []
+    analysis = info.analysis
+    decorated = _surface_decorations(analysis)
+    out: list[Finding] = []
+    seen_roots: set[int] = set()
+    for root in analysis.jit_infos:
+        if id(root) in seen_roots:
+            continue
+        seen_roots.add(id(root))
+        cur: Optional[ast.AST] = root
+        registered = False
+        while cur is not None:
+            if id(cur) in decorated:
+                registered = True
+                break
+            cur = analysis.parent.get(cur)
+        if not registered:
+            label = getattr(root, "name", "<lambda>")
+            out.append(_finding(
+                info, "DCR010", root,
+                f"jit entry point '{label}' in an entry-point module is not "
+                "registered with @compile_surface — the compile-surface "
+                "manifest cannot fingerprint it, so a PR touching it could "
+                "introduce recompiles CI never sees; register it (and run "
+                "`python -m tools.check --update-manifest`)"))
+    return out
+
+
+def check_manifest_coverage(index: ProgramIndex, cfg: CheckConfig,
+                            manifest_path: Path) -> list[Finding]:
+    """Static cross-check between the @compile_surface registrations and the
+    checked-in compile_manifest.json — pure JSON, no jax import, so the
+    bare-checkout static-analysis job can run it."""
+    surfaces = registered_surfaces(index, cfg)
+    out: list[Finding] = []
+    if not manifest_path.is_file():
+        if any(surfaces.values()):
+            out.append(Finding(
+                rule="DCR010", path=str(cfg.manifest), line=1, col=0,
+                message=f"compile manifest {cfg.manifest} is missing but "
+                        f"{sum(surfaces.values())} registered surfaces "
+                        "expect fingerprints — run `python -m tools.check "
+                        "--update-manifest` and commit the result",
+                snippet=""))
+        return out
+    data = json.loads(manifest_path.read_text(encoding="utf-8"))
+    entries = data.get("entries", {})
+    covered = {e.get("surface") for e in entries.values()}
+    for name, wants_manifest in sorted(surfaces.items()):
+        if wants_manifest and name not in covered:
+            out.append(Finding(
+                rule="DCR010", path=str(cfg.manifest), line=1, col=0,
+                message=f"registered compile surface '{name}' has no entry "
+                        "in the compile manifest — run `python -m "
+                        "tools.check --update-manifest` and commit the "
+                        "result", snippet=""))
+    for key, entry in sorted(entries.items()):
+        if entry.get("surface") not in surfaces:
+            out.append(Finding(
+                rule="DCR010", path=str(cfg.manifest), line=1, col=0,
+                message=f"manifest entry '{key}' no longer corresponds to "
+                        "any @compile_surface registration — stale entry; "
+                        "run `python -m tools.check --update-manifest`",
+                snippet=""))
+    return out

@@ -1,0 +1,265 @@
+"""Representative builders for every fingerprinted compile surface.
+
+Each spec builds the REAL production jit entry point — the same builder the
+trainer/server/samplers call, imported from the product module — under a
+representative config, and hands (fn, abstract args, static knobs) to
+:func:`tools.check.manifest.fingerprint`. Conventions:
+
+- **workload knobs are the production defaults** (serve bucket resolution/
+  steps/guidance, sampler ids, batch sizes): a PR that changes a default
+  bucket shape or a sampler's static wiring changes the fingerprint;
+- **model dims are ``ModelConfig.tiny()``** so lowering stays seconds, not
+  minutes: a changed *model default* is out of scope here (it is a weights-
+  compat change, not a serve-shape change) — the static_config field still
+  records the knobs that matter;
+- **one device, fixed mesh** (``MeshConfig(data=1)`` over ``devices[:1]``)
+  so fingerprints are identical on a laptop, this container, and CI
+  regardless of host core count or ``xla_force_host_platform_device_count``;
+- everything is lowered abstractly (ShapeDtypeStruct args, eval_shape'd
+  param trees) — no weights exist, nothing executes, no devices beyond the
+  one CPU stub are touched.
+
+Adding a surface: decorate the builder with ``@compile_surface``, append a
+spec here covering that surface name, then ``python -m tools.check
+--update-manifest``. DCR010 fails CI until all three are done.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from tools.check.manifest import fingerprint
+
+
+@dataclass(frozen=True)
+class SurfaceSpec:
+    key: str          # manifest entry key, "<surface>@<variant>"
+    surface: str      # @compile_surface family name it fingerprints
+    variant: str
+    build: Callable[[], dict]   # -> fingerprint() kwargs
+
+
+def _mesh1():
+    import jax
+
+    from dcr_tpu.core.config import MeshConfig
+    from dcr_tpu.parallel import mesh as pmesh
+
+    return pmesh.make_mesh(MeshConfig(data=1), devices=jax.devices()[:1])
+
+
+def _tiny_train_cfg():
+    from dcr_tpu.core.config import ModelConfig, TrainConfig
+
+    cfg = TrainConfig(train_batch_size=2, mixed_precision="no")
+    cfg.model = ModelConfig.tiny()
+    return cfg
+
+
+def _abstract_params(cfg):
+    """Abstract {"unet","vae","text"} param tree — eval_shape over the real
+    initializers, zero memory."""
+    import jax
+
+    from dcr_tpu.diffusion.trainer import build_models
+
+    return jax.eval_shape(lambda k: build_models(cfg, k)[1],
+                          jax.random.key(0))
+
+
+def _pixels(cfg):
+    """Training pixel resolution implied by the tiny model: latent
+    sample_size x the VAE downscale factor."""
+    from dcr_tpu.models.vae import vae_scale_factor
+
+    return cfg.model.sample_size * vae_scale_factor(cfg.model)
+
+
+def _build_train_step() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.diffusion import train as T
+    from dcr_tpu.diffusion.trainer import abstract_train_state, build_modules
+
+    cfg = _tiny_train_cfg()
+    mesh = _mesh1()
+    models = build_modules(cfg)
+    state = abstract_train_state(cfg)
+    step_fn = T.make_train_step(cfg, models, mesh)
+    bsz = cfg.train_batch_size  # one device on the representative mesh
+    px = _pixels(cfg)
+    batch = {
+        "pixel_values": jax.ShapeDtypeStruct((bsz, px, px, 3), jnp.float32),
+        "input_ids": jax.ShapeDtypeStruct(
+            (bsz, cfg.model.text_max_length), jnp.int32),
+    }
+    return dict(
+        fn=step_fn, args=(state, batch, rngmod.root_key(0)),
+        donate_argnums=(0,),
+        static_config={
+            "mixed_precision": cfg.mixed_precision,
+            "remat": cfg.remat,
+            "train_text_encoder": cfg.train_text_encoder,
+            "ema_decay": cfg.ema_decay,
+            "rand_noise_lam": cfg.rand_noise_lam,
+            "mixup_noise_lam": cfg.mixup_noise_lam,
+            "gradient_accumulation_steps":
+                cfg.optim.gradient_accumulation_steps,
+            "use_8bit_adam": cfg.optim.use_8bit_adam,
+            "max_grad_norm": cfg.optim.max_grad_norm,
+            "train_batch_size": cfg.train_batch_size,
+        })
+
+
+def _build_params_finite() -> dict:
+    from dcr_tpu.diffusion import train as T
+    from dcr_tpu.diffusion.trainer import _params_finite, abstract_train_state
+
+    cfg = _tiny_train_cfg()
+    state = abstract_train_state(cfg)
+    tree = T.trainable_of(state, cfg.train_text_encoder)
+    return dict(fn=_params_finite, args=(tree,), static_config={})
+
+
+def _build_serve_bucket(sampler: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.diffusion.trainer import build_modules
+    from dcr_tpu.serve.queue import GenBucket
+    from dcr_tpu.serve.worker import make_batch_sampler
+
+    scfg = ServeConfig(sampler=sampler)
+    cfg = _tiny_train_cfg()
+    models = build_modules(cfg)
+    bucket = GenBucket(resolution=scfg.resolution,
+                       steps=scfg.num_inference_steps,
+                       guidance=scfg.guidance_scale, sampler=sampler,
+                       rand_noise_lam=scfg.rand_noise_lam)
+    fn = make_batch_sampler(bucket, models, scfg.seed, scfg.max_batch)
+    params = _abstract_params(cfg)
+    L = cfg.model.text_max_length
+    D = cfg.model.text_hidden_size
+    emb = jax.ShapeDtypeStruct((scfg.max_batch, L, D), jnp.float32)
+    seeds = jax.ShapeDtypeStruct((scfg.max_batch,), jnp.uint32)
+    return dict(
+        fn=fn, args=(params, emb, emb, seeds),
+        static_config={
+            "resolution": bucket.resolution, "steps": bucket.steps,
+            "guidance": bucket.guidance, "sampler": bucket.sampler,
+            "rand_noise_lam": bucket.rand_noise_lam,
+            "max_batch": scfg.max_batch,
+        })
+
+
+def _build_bulk_sampler(sampler: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.core.config import SampleConfig
+    from dcr_tpu.diffusion.trainer import build_modules
+    from dcr_tpu.sampling.sampler import make_sampler
+
+    pcfg = SampleConfig(sampler=sampler)
+    cfg = _tiny_train_cfg()
+    models = build_modules(cfg)
+    fn = make_sampler(pcfg, models, _mesh1())
+    params = _abstract_params(cfg)
+    ids = jax.ShapeDtypeStruct((pcfg.im_batch, cfg.model.text_max_length),
+                               jnp.int32)
+    return dict(
+        fn=fn, args=(params, ids, ids, rngmod.root_key(0)),
+        static_config={
+            "resolution": pcfg.resolution,
+            "num_inference_steps": pcfg.num_inference_steps,
+            "guidance_scale": pcfg.guidance_scale, "sampler": sampler,
+            "rand_noise_lam": pcfg.rand_noise_lam,
+            "im_batch": pcfg.im_batch,
+        })
+
+
+def _build_serve_encode() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.diffusion.trainer import build_modules
+    from dcr_tpu.serve.worker import make_text_encoder
+
+    cfg = _tiny_train_cfg()
+    fn = make_text_encoder(build_modules(cfg))
+    params = _abstract_params(cfg)["text"]
+    ids = jax.ShapeDtypeStruct((1, cfg.model.text_max_length), jnp.int32)
+    return dict(fn=fn, args=(params, ids),
+                static_config={"text_max_length": cfg.model.text_max_length})
+
+
+def _build_eval_embed() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import EvalConfig
+    from dcr_tpu.eval.features import make_extractor
+    from dcr_tpu.models.resnet import SSCDModel
+
+    ecfg = EvalConfig()   # sscd / 224 — the default copy-detection metric
+    mesh = _mesh1()
+    model = SSCDModel(embed_dim=512)
+    # abstract init: the extractor takes params as a jit argument (see
+    # make_extractor), so a ShapeDtypeStruct tree lowers the real program
+    params = jax.eval_shape(
+        model.init, jax.random.key(0),
+        jax.ShapeDtypeStruct((1, ecfg.image_size, ecfg.image_size, 3),
+                             jnp.float32))["params"]
+
+    def apply_fn(p, x):
+        return model.apply({"params": p}, x)
+
+    extractor = make_extractor(apply_fn, params, mesh,
+                               multiscale=ecfg.multiscale)
+    images = jax.ShapeDtypeStruct(
+        (ecfg.batch_size, ecfg.image_size, ecfg.image_size, 3), jnp.float32)
+    # extractor == partial(jitted_forward, params): lower the underlying
+    # jitted program over (params, images)
+    return dict(fn=extractor.func, args=extractor.args + (images,),
+                static_config={
+                    "pt_style": ecfg.pt_style, "arch": "sscd_resnet50",
+                    "image_size": ecfg.image_size,
+                    "batch_size": ecfg.batch_size,
+                    "multiscale": ecfg.multiscale,
+                })
+
+
+SAMPLERS = ("ddim", "dpm++", "ddpm")
+
+SURFACES: tuple[SurfaceSpec, ...] = (
+    SurfaceSpec("train/step@default", "train/step", "default",
+                _build_train_step),
+    SurfaceSpec("train/params_finite@default", "train/params_finite",
+                "default", _build_params_finite),
+    *(SurfaceSpec(f"serve/batch_sampler@{s}", "serve/batch_sampler", s,
+                  (lambda s=s: _build_serve_bucket(s))) for s in SAMPLERS),
+    *(SurfaceSpec(f"sample/sampler@{s}", "sample/sampler", s,
+                  (lambda s=s: _build_bulk_sampler(s))) for s in SAMPLERS),
+    SurfaceSpec("serve/encode@default", "serve/encode", "default",
+                _build_serve_encode),
+    SurfaceSpec("eval/embed@default", "eval/embed", "default",
+                _build_eval_embed),
+)
+
+
+def generate_entries(specs=SURFACES, *, log=print) -> dict[str, dict]:
+    entries: dict[str, dict] = {}
+    for spec in specs:
+        log(f"dcr-check: lowering {spec.key} ...")
+        kwargs = spec.build()
+        entries[spec.key] = fingerprint(
+            spec.key, kwargs["fn"], kwargs["args"],
+            static_config=kwargs.get("static_config", {}),
+            donate_argnums=kwargs.get("donate_argnums", ()),
+            surface=spec.surface, variant=spec.variant)
+    return entries

@@ -11,7 +11,8 @@ import sys
 from pathlib import Path
 
 from tools.lint.config import load_config
-from tools.lint.engine import LintError, scan, write_baseline
+from tools.lint.engine import (LintError, github_annotation, parse_failures,
+                               scan, write_baseline)
 from tools.lint.rules import RULES
 
 
@@ -22,7 +23,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     "static analysis for the dcr_tpu stack")
     p.add_argument("paths", nargs="*", default=["dcr_tpu", "tests", "tools"],
                    help="files/directories to scan (default: dcr_tpu tests tools)")
-    p.add_argument("--format", choices=("human", "json"), default="human")
+    p.add_argument("--format", choices=("human", "json", "github"),
+                   default="human",
+                   help="github = GitHub Actions ::error annotations "
+                        "(findings surface inline on the PR diff)")
     p.add_argument("--select", default=None,
                    help="comma-separated rule ids to run (overrides config)")
     p.add_argument("--ignore", default=None,
@@ -76,8 +80,12 @@ def main(argv: list[str] | None = None) -> int:
               "fill in each justification (the run fails until you do)")
         return 0
 
+    broken = parse_failures(report.findings)
     if args.format == "json":
         print(json.dumps(report.to_json(), indent=2))
+    elif args.format == "github":
+        for f in report.findings:
+            print(github_annotation(f))
     else:
         for f in report.findings:
             print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
@@ -92,6 +100,14 @@ def main(argv: list[str] | None = None) -> int:
               f"({summary}) in {report.files_scanned} files "
               f"[suppressed: {report.baseline_suppressed} baseline, "
               f"{report.pragma_suppressed} pragma]")
+    if broken:
+        # the scan is INCOMPLETE over unparseable files: that is a
+        # configuration error (exit 2), not an ordinary finding (exit 1)
+        for f in broken:
+            print(f"dcr-lint: error: {f.path}:{f.line}: {f.message} — "
+                  "file could not be parsed; the scan is incomplete",
+                  file=sys.stderr)
+        return 2
     return 1 if report.findings else 0
 
 

@@ -411,3 +411,29 @@ def _walk_shallow(stmt: ast.AST) -> Iterator[ast.AST]:
                 stack.append(value)
             elif isinstance(value, list):
                 stack.extend(v for v in value if isinstance(v, ast.AST))
+
+
+_LOOP = (ast.For, ast.AsyncFor, ast.While)
+
+
+def enclosing_loop(body: list[ast.stmt], stmt: ast.stmt) -> Optional[ast.AST]:
+    """Innermost For/While in this scope whose subtree contains ``stmt``
+    (nested function/lambda bodies excluded), or None when ``stmt`` is not
+    under a loop. Used by the DCR002 loop leg in both layers: a donated arg
+    rebound by a LATER statement of the same loop body is fresh again on the
+    next iteration, so only truly un-rebound donation gets flagged."""
+
+    def walk(node: ast.AST, current: Optional[ast.AST]) -> bool:
+        if node is stmt:
+            found.append(current)
+            return True
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            return False
+        nxt = node if isinstance(node, _LOOP) else current
+        return any(walk(child, nxt) for child in ast.iter_child_nodes(node))
+
+    found: list[Optional[ast.AST]] = []
+    for top in body:
+        if walk(top, None):
+            break
+    return found[0] if found else None

@@ -68,6 +68,23 @@ class Report:
         }
 
 
+def github_annotation(f: Finding) -> str:
+    """One GitHub Actions workflow command per finding — surfaces inline on
+    the PR diff when printed from a CI job. Newlines are %0A-escaped per the
+    workflow-command spec."""
+    msg = f.message.replace("%", "%25").replace("\r", "%0D").replace("\n", "%0A")
+    return (f"::error file={f.path},line={f.line},col={f.col + 1},"
+            f"title={f.rule}::{msg}")
+
+
+def parse_failures(findings: Sequence[Finding]) -> list[Finding]:
+    """DCR000 pseudo-findings: files the scan could not parse. The scan is
+    incomplete over those files, so CLIs report them as exit-2 configuration
+    errors (with the finding as the structured diagnostic), not as ordinary
+    exit-1 findings."""
+    return [f for f in findings if f.rule == "DCR000"]
+
+
 def _pragma_rules(line: str) -> set[str]:
     m = _PRAGMA_RE.search(line)
     if not m:
@@ -145,6 +162,8 @@ def load_baseline(path: Path) -> list[dict]:
 def write_baseline(path: Path, findings: Sequence[Finding]) -> None:
     counts: dict[tuple[str, str, str], int] = {}
     for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        if f.rule == "DCR000":
+            continue  # parse failures are exit-2 errors, never grandfathered
         counts[f.key()] = counts.get(f.key(), 0) + 1
     entries = [
         {"rule": rule, "path": fpath, "snippet": snippet,
@@ -175,6 +194,11 @@ def iter_py_files(paths: Sequence[Path], cfg: LintConfig) -> list[Path]:
             # an explicitly named file that would be silently skipped is a
             # misconfigured invocation, not a clean scan
             raise LintError(f"not a Python file: {p}")
+        if p.is_file() and p.stat().st_size == 0:
+            # an explicitly named empty file means the invocation points at
+            # the wrong thing (a truncated write, a bad glob) — surface it as
+            # a configuration error instead of silently reporting "clean"
+            raise LintError(f"empty file: {p} (nothing to scan)")
         candidates = [p] if p.is_file() else sorted(p.rglob("*.py"))
         for c in candidates:
             rel = _relpath(c, cfg.root)
@@ -207,7 +231,15 @@ def scan(paths: Sequence[str | Path], cfg: Optional[LintConfig] = None, *,
         if not selected:
             continue
         scanned_rel.add(rel)
-        source = path.read_text(encoding="utf-8", errors="replace")
+        try:
+            source = path.read_text(encoding="utf-8")
+        except UnicodeDecodeError as e:
+            # a non-UTF8 .py file is unreadable to CPython itself; lint-
+            # skipping it silently would report a clean scan over a file the
+            # rules never saw — structured exit-2 diagnostic instead
+            raise LintError(
+                f"{rel}: not valid UTF-8 ({e.reason} at byte {e.start}) — "
+                "the scan is incomplete; fix the file encoding") from e
         found, n_pragma = lint_source_counted(source, rel, rules=sorted(selected))
         report.pragma_suppressed += n_pragma
         raw.extend(found)
@@ -228,6 +260,12 @@ def scan(paths: Sequence[str | Path], cfg: Optional[LintConfig] = None, *,
     for f in raw:
         suppressed = False
         for i, entry in enumerate(entries):
+            if f.rule == "DCR000":
+                # a parse failure can never be grandfathered: a baselined
+                # DCR000 would report "clean" (exit 0) over a file the rules
+                # never saw, silently defeating the exit-2 incomplete-scan
+                # contract
+                break
             if budget[i] > 0 and \
                     (entry["rule"], entry["path"], entry["snippet"]) == f.key():
                 matched_entries.add(i)
@@ -238,10 +276,13 @@ def scan(paths: Sequence[str | Path], cfg: Optional[LintConfig] = None, *,
             report.baseline_suppressed += 1
         else:
             report.findings.append(f)
-    # an entry is stale only when its file WAS scanned and nothing matched —
-    # partial scans (one file, a subdir) must not cry wolf about the rest
+    # an entry is stale when its file WAS scanned and nothing matched —
+    # partial scans (one file, a subdir) must not cry wolf about the rest —
+    # or when its file no longer exists at all: a deleted file can never
+    # match any scan, so keeping its entry around only hides baseline rot
     report.stale_baseline = [e for i, e in enumerate(entries)
                              if i not in matched_entries
-                             and e["path"] in scanned_rel]
+                             and (e["path"] in scanned_rel
+                                  or not (cfg.root / e["path"]).is_file())]
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return report

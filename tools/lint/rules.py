@@ -15,7 +15,8 @@ import ast
 from dataclasses import dataclass
 from typing import Callable, Optional
 
-from tools.lint.analysis import FuncNode, LinearStmt, ModuleAnalysis
+from tools.lint.analysis import (FuncNode, LinearStmt, ModuleAnalysis,
+                                 enclosing_loop)
 
 
 @dataclass(frozen=True)
@@ -122,6 +123,14 @@ def check_dcr002(analysis: ModuleAnalysis) -> list[Finding]:
                     if name in analysis.bound_names(ls.stmt):
                         continue  # x, ... = f(x, ...) — the donated name is rebound
                     if ls.loop_depth > 0:
+                        loop = enclosing_loop(body, ls.stmt)
+                        if loop is not None and (
+                                name in analysis.bound_names(loop) or any(
+                                    name in analysis.bound_names(inner.stmt)
+                                    for inner in analysis.linearize(loop.body, 1)
+                                    if inner.stmt is not ls.stmt)):
+                            continue  # rebound in the loop body (or the loop
+                            # target itself): fresh before the next iteration
                         out.append(_finding(
                             analysis, "DCR002", call,
                             f"'{name}' is donated to {call.func.id}() inside a "
