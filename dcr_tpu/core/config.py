@@ -300,6 +300,40 @@ class SampleConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Multi-worker serving fleet (dcr_tpu/serve/supervisor.py): one
+    supervisor process owns the HTTP front end, the admission queue, and the
+    durable in-flight request journal; N device-worker subprocesses join via
+    heartbeat-leased membership and pull bucket-coherent batches over
+    per-worker dispatch channels. A worker that dies — crash, preemption
+    (83), hang watchdog (89) — has its journaled in-flight requests requeued
+    onto survivors (safe: every image is a pure function of (ckpt, prompt,
+    seed, bucket)) and is respawned with bounded backoff.
+    """
+
+    workers: int = 0          # >0 runs dcr-serve as a fleet supervisor
+    worker_index: int = -1    # >=0 marks a fleet WORKER process (set by the
+    #                           supervisor when spawning; not set by hand)
+    dir: str = ""             # control-plane dir: leases, journal, worker logs
+    #                           ("" = a directory beside --logdir or a tmpdir)
+    heartbeat_s: float = 1.0  # worker lease renewal period
+    lease_s: float = 5.0      # lease expiry: a worker silent this long is dead
+    # supervisor-side bound on one dispatched batch (covers compile on first
+    # use); an overrun declares the worker hung, SIGKILLs it, and requeues
+    dispatch_timeout_s: float = 600.0
+    max_attempts: int = 3     # dispatch attempts per request before a typed 500
+    respawn_max: int = 3      # consecutive spawn failures before a slot retires
+    respawn_base_delay_s: float = 0.5
+    respawn_max_delay_s: float = 10.0
+    spawn_timeout_s: float = 600.0  # worker must publish its lease within this
+    # load shedding: reject admission with 503 + Retry-After while queue-wait
+    # p99 (from the telemetry registry) exceeds this AND a backlog exists.
+    # 0 disables shedding.
+    slo_queue_wait_p99_s: float = 0.0
+    shed_retry_after_s: float = 5.0  # Retry-After hint on shed responses
+
+
+@dataclass
 class ServeConfig:
     """Online generation service (dcr_tpu/serve/): a resident compiled sampler
     behind an HTTP front end with dynamic batching, an LRU prompt-embedding
@@ -344,6 +378,7 @@ class ServeConfig:
     logdir: str = ""                       # MetricWriter sink ("" = off)
     seed: int = 42                         # folds into per-request keys
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
 
 def validate_serve_config(cfg: ServeConfig) -> None:
@@ -359,6 +394,25 @@ def validate_serve_config(cfg: ServeConfig) -> None:
         raise ValueError("serve cache_entries must be >= 0")
     if cfg.max_compiled_buckets < 1:
         raise ValueError("serve max_compiled_buckets must be >= 1")
+    f = cfg.fleet
+    if f.workers < 0:
+        raise ValueError("fleet.workers must be >= 0")
+    if f.workers > 0 and f.worker_index >= 0:
+        raise ValueError("fleet.workers and fleet.worker_index are mutually "
+                         "exclusive (supervisor vs worker role)")
+    if f.workers > 0 or f.worker_index >= 0:
+        if f.heartbeat_s <= 0 or f.lease_s <= f.heartbeat_s:
+            raise ValueError("fleet.lease_s must exceed fleet.heartbeat_s > 0 "
+                             "(a lease shorter than its renewal period "
+                             "expires between heartbeats)")
+        if f.dispatch_timeout_s <= 0:
+            raise ValueError("fleet.dispatch_timeout_s must be > 0 (an "
+                             "unbounded dispatch turns a hung worker into a "
+                             "hung fleet)")
+        if f.max_attempts < 1:
+            raise ValueError("fleet.max_attempts must be >= 1")
+        if f.respawn_max < 0:
+            raise ValueError("fleet.respawn_max must be >= 0")
 
 
 @dataclass

@@ -1,6 +1,6 @@
 """dcr-serve: the online generation service.
 
-Layer map (all single-host, single-device-owner):
+Layer map:
 
 - :mod:`dcr_tpu.serve.queue` — bounded admission queue, typed overload/drain
   rejections, bucket-tagged requests;
@@ -11,9 +11,14 @@ Layer map (all single-host, single-device-owner):
 - :mod:`dcr_tpu.serve.worker` — the resident core: per-bucket compiled
   samplers at a fixed padded batch shape, per-request PRNG keys, watchdog;
 - :mod:`dcr_tpu.serve.server` — stdlib HTTP front end
-  (POST /generate, GET /healthz, GET /metrics).
+  (POST /generate, GET /healthz, GET /metrics);
+- :mod:`dcr_tpu.serve.fleet` — fleet control plane: heartbeat-leased worker
+  membership plus the durable request journal (the zero-drop ledger);
+- :mod:`dcr_tpu.serve.supervisor` — N device workers behind one front end:
+  dispatch channels, requeue-on-death, respawn with backoff, SLO shedding.
 
-Entry point: ``dcr-serve`` (:mod:`dcr_tpu.cli.serve`). SIGTERM stops
+Entry point: ``dcr-serve`` (:mod:`dcr_tpu.cli.serve`); ``--fleet.workers=N``
+selects the supervisor role (README "Serving at scale"). SIGTERM stops
 admission, finishes in-flight batches, and exits with
 :data:`dcr_tpu.core.coordination.EXIT_PREEMPTED` (83).
 """
@@ -22,14 +27,16 @@ from dcr_tpu.serve.batcher import Batcher, should_flush
 from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket,
-                                 InvalidRequestError, QueueFullError, Request,
-                                 RequestQueue)
+                                 InvalidRequestError, NoWorkersError,
+                                 QueueFullError, Request, RequestQueue,
+                                 SloShedError)
 from dcr_tpu.serve.worker import (GenerationService, make_batch_sampler,
                                   validate_bucket)
 
 __all__ = [
     "AdmissionError", "Batcher", "BucketLimitError", "DrainingError",
     "EmbeddingCache", "GenBucket", "GenerationService", "InvalidRequestError",
-    "QueueFullError", "Request", "RequestQueue", "embedding_key",
-    "make_batch_sampler", "mitigation_tag", "should_flush", "validate_bucket",
+    "NoWorkersError", "QueueFullError", "Request", "RequestQueue",
+    "SloShedError", "embedding_key", "make_batch_sampler", "mitigation_tag",
+    "should_flush", "validate_bucket",
 ]

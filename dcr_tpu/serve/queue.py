@@ -45,6 +45,27 @@ class BucketLimitError(AdmissionError):
     parameters could grow device/host memory without limit."""
 
 
+class SloShedError(AdmissionError):
+    """The fleet is shedding load: queue-wait p99 breached the configured SLO
+    while a backlog exists (HTTP 503 with a Retry-After hint). Distinct from
+    :class:`QueueFullError` — the queue has room, but anything admitted now
+    would wait past the latency objective anyway."""
+
+    def __init__(self, msg: str, retry_after_s: float = 5.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class NoWorkersError(AdmissionError):
+    """No fleet worker has joined (yet), so an admitted request could not be
+    dispatched anywhere (HTTP 503 with Retry-After — workers are compiling
+    or respawning; balancers should retry shortly)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 5.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class GenBucket(NamedTuple):
     """The static generation parameters one compiled sampler serves. Two
     requests batch together iff their buckets are equal — everything here is
@@ -108,6 +129,20 @@ class RequestQueue:
                     f"admission queue full ({self.maxsize} pending)")
             req.enqueued_at = time.monotonic()
             self._items.append(req)
+            self._cond.notify_all()
+
+    def requeue(self, reqs: list[Request]) -> None:
+        """Put already-ACCEPTED requests back at the HEAD of the queue, in
+        order (fleet supervisor path: their worker died mid-batch). Bypasses
+        both the admission bound and the closed flag deliberately — these
+        requests were admitted once and the zero-drop contract says they
+        complete even during a drain; their original ``enqueued_at`` stamps
+        are preserved so queue-wait telemetry and the batcher's deadline see
+        the true wait, not a reset clock."""
+        if not reqs:
+            return
+        with self._cond:
+            self._items[:0] = reqs
             self._cond.notify_all()
 
     def close(self) -> None:

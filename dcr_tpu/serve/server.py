@@ -33,20 +33,50 @@ import json
 import logging
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig
-from dcr_tpu.serve.queue import (BucketLimitError, DrainingError, GenBucket,
-                                 InvalidRequestError, QueueFullError)
+from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
+                                 DrainingError, GenBucket,
+                                 InvalidRequestError, NoWorkersError,
+                                 QueueFullError, SloShedError)
 from dcr_tpu.serve.worker import GenerationService
 
 log = logging.getLogger("dcr_tpu")
 
 _ALLOWED_OVERRIDES = ("seed", "steps", "guidance", "sampler", "rand_noise_lam",
                       "resolution")
+
+# typed admission rejection -> (HTTP status, wire error tag). SloShedError
+# and NoWorkersError additionally carry a Retry-After hint so balancers and
+# well-behaved clients back off for a concrete interval instead of retrying
+# into the same overload.
+_ADMISSION_RESPONSES = (
+    (InvalidRequestError, 400, "bad_request"),
+    (QueueFullError, 503, "overloaded"),
+    (BucketLimitError, 503, "bucket_limit"),
+    (DrainingError, 503, "draining"),
+    (SloShedError, 503, "shed"),
+    (NoWorkersError, 503, "no_workers"),
+)
+
+
+def admission_response(e: AdmissionError) -> tuple[int, dict, dict]:
+    """(status, payload, extra headers) for a typed admission rejection."""
+    for cls, code, tag in _ADMISSION_RESPONSES:
+        if isinstance(e, cls):
+            payload = ({"error": f"bad request: {e}"} if code == 400
+                       else {"error": tag, "detail": str(e)})
+            headers = {}
+            retry_after = getattr(e, "retry_after_s", None)
+            if retry_after is not None:
+                headers["Retry-After"] = str(max(1, round(retry_after)))
+            return code, payload, headers
+    return 503, {"error": "overloaded", "detail": str(e)}, {}
 
 
 def png_bytes(image: np.ndarray) -> bytes:
@@ -90,11 +120,14 @@ class ServeHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route access logs through logging
         log.debug("serve http: " + fmt, *args)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[dict] = None) -> None:
         data = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -110,7 +143,11 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         url = urlparse(self.path)
         if url.path == "/healthz":
-            status = "draining" if self.service.draining else "ok"
+            # fleet supervisors report richer states (warming/failed);
+            # single-process services keep the historical ok/draining pair
+            health = getattr(self.service, "health", None)
+            status = (health() if callable(health)
+                      else "draining" if self.service.draining else "ok")
             self._reply(200, {"status": status})
         elif url.path == "/metrics":
             fmt = parse_qs(url.query).get("format", ["json"])[0]
@@ -127,39 +164,55 @@ class ServeHandler(BaseHTTPRequestHandler):
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
 
+    def _parse_one(self, body: dict) -> tuple[str, int, GenBucket]:
+        if not isinstance(body, dict):
+            raise ValueError("body must be a JSON object")
+        prompt = body["prompt"]
+        if not isinstance(prompt, str) or not prompt.strip():
+            raise ValueError("'prompt' must be a non-empty string")
+        bucket = request_bucket(self.service, body)
+        return prompt, int(body.get("seed", 0)), bucket
+
+    def _render(self, req, result) -> dict:
+        """The /generate response document. A fleet supervisor's future
+        resolves to the worker's already-rendered document (dict) — passed
+        through verbatim, bar the id, so a response is bit-identical whether
+        the batch ran on worker 0, worker 3, or a respawn after a crash. A
+        single-process service resolves to the raw image array."""
+        if isinstance(result, dict):
+            return {**result, "id": req.id, "latency_ms": None}
+        return {
+            "id": req.id,
+            "image_png_b64": base64.b64encode(png_bytes(result)).decode(),
+            "width": int(result.shape[1]),
+            "height": int(result.shape[0]),
+            "cache_hit": bool(req.cache_hit),
+            "latency_ms": None,  # client-side wall time is the honest number
+        }
+
     def do_POST(self) -> None:
-        if self.path != "/generate":
+        if self.path == "/generate":
+            self._post_generate()
+        elif self.path == "/generate_batch":
+            self._post_generate_batch()
+        else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
-            return
+
+    def _post_generate(self) -> None:
         try:
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"{}")
-            if not isinstance(body, dict):
-                raise ValueError("body must be a JSON object")
-            prompt = body["prompt"]
-            if not isinstance(prompt, str) or not prompt.strip():
-                raise ValueError("'prompt' must be a non-empty string")
-            bucket = request_bucket(self.service, body)
-            seed = int(body.get("seed", 0))
+            prompt, seed, bucket = self._parse_one(body)
         except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
             self._reply(400, {"error": f"bad request: {e!r}"})
             return
         try:
             req = self.service.submit(prompt, seed=seed, bucket=bucket)
-        except InvalidRequestError as e:
-            self._reply(400, {"error": f"bad request: {e}"})
-            return
-        except QueueFullError:
-            self._reply(503, {"error": "overloaded"})
-            return
-        except BucketLimitError as e:
-            self._reply(503, {"error": "bucket_limit", "detail": str(e)})
-            return
-        except DrainingError:
-            self._reply(503, {"error": "draining"})
+        except AdmissionError as e:
+            self._reply(*admission_response(e))
             return
         try:
-            image = req.future.result(timeout=self.cfg.request_timeout_s)
+            result = req.future.result(timeout=self.cfg.request_timeout_s)
         except FutureTimeout:
             self._reply(504, {"error": "request timed out in queue/batch"})
             return
@@ -170,14 +223,46 @@ class ServeHandler(BaseHTTPRequestHandler):
         # happen on this handler thread, off the device worker's critical path
         with tracing.span("serve/respond", request_id=req.id,
                           parent=req.span.id if req.span is not None else None):
-            self._reply(200, {
-                "id": req.id,
-                "image_png_b64": base64.b64encode(png_bytes(image)).decode(),
-                "width": int(image.shape[1]),
-                "height": int(image.shape[0]),
-                "cache_hit": bool(req.cache_hit),
-                "latency_ms": None,  # client-side wall time is the honest number
-            })
+            self._reply(200, self._render(req, result))
+
+    def _post_generate_batch(self) -> None:
+        """The fleet dispatch channel's wire call: a bucket-coherent batch
+        submitted together, answered together. Item results are positional;
+        a per-item failure is an ``{"error": ...}`` item (the supervisor
+        fails exactly that request), while a malformed envelope is a 400
+        (the supervisor requeues the whole batch elsewhere)."""
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            items = body["requests"]
+            if not isinstance(items, list) or not items:
+                raise ValueError("'requests' must be a non-empty list")
+        except (KeyError, TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        reqs: list = []
+        for item in items:
+            try:
+                prompt, seed, bucket = self._parse_one(item)
+                reqs.append(self.service.submit(prompt, seed=seed,
+                                                bucket=bucket))
+            except (KeyError, TypeError, ValueError, AdmissionError) as e:
+                reqs.append({"error": f"{type(e).__name__}: {e}"})
+        results: list[dict] = []
+        for req in reqs:
+            if isinstance(req, dict):        # rejected at submit
+                results.append(req)
+                continue
+            try:
+                image = req.future.result(timeout=self.cfg.request_timeout_s)
+            except Exception as e:  # timeout or generation failure: per-item
+                results.append({"error": f"{type(e).__name__}: {e}"})
+                continue
+            with tracing.span("serve/respond", request_id=req.id,
+                              parent=req.span.id if req.span is not None
+                              else None):
+                results.append(self._render(req, image))
+        self._reply(200, {"results": results})
 
 
 def make_server(cfg: ServeConfig,

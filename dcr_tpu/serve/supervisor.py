@@ -1,0 +1,803 @@
+"""Fleet supervisor: N device workers behind one front end, zero dropped
+requests across worker death.
+
+Topology (``dcr-serve --fleet.workers=N``)::
+
+    supervisor process                         worker subprocess (xN)
+    ------------------                         ----------------------
+    HTTP front end (serve/server.py)           GenerationService (PR 4)
+    bounded RequestQueue  <- admission         own HTTP server, port 0
+    RequestJournal        <- zero-drop ledger  lease publish + heartbeat
+    DispatchChannel xN    -> POST /generate_batch -> dynamic batching,
+    monitor thread: leases, respawn, SLO          compiled samplers,
+                                                  hang watchdog (exit 89)
+
+The supervisor owns admission and accounting; workers own devices. A
+dispatch channel pulls bucket-coherent batches from the shared queue (the
+same :class:`~dcr_tpu.serve.batcher.Batcher` policy as single-process
+serve) only while its worker is alive — per-worker flow control is the
+channel itself, which keeps at most one batch in flight per worker, so the
+in-flight set per worker is exactly one journal batch.
+
+Failure model — every path ends in "requeue, respawn, keep serving":
+
+- **crash** (SIGKILL, segfault, injected ``worker_crash``): the in-flight
+  HTTP call breaks, the channel requeues the batch at the queue HEAD and the
+  monitor respawns the worker with bounded exponential backoff;
+- **hang** (injected ``worker_hang``, wedged device step): the worker's own
+  batch watchdog exits 89; if that is disabled, the supervisor's
+  ``fleet.dispatch_timeout_s`` expires, the worker is SIGKILLed, same path;
+- **preemption** (external SIGTERM, exit 83): treated as a death — the
+  worker drains what it holds, everything else requeues;
+- **lease lapse** (process frozen but not dead): SIGKILL + requeue.
+
+Requeue is SAFE to re-execute because PR 4 made every image a pure function
+of (ckpt, prompt, seed, bucket) — a re-run on another worker is
+bit-identical, and the journal's first-completion-wins ack means a client
+never sees two answers. When queue-wait p99 (telemetry registry) breaches
+``fleet.slo_queue_wait_p99_s`` with a real backlog, admission sheds typed
+503s with Retry-After instead of quietly growing the queue. When every
+worker slot exhausts its respawn budget the supervisor fails loudly: pending
+futures get typed errors, the flight recorder dumps, and the front end
+reports "failed".
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+from dcr_tpu.core.config import ServeConfig, to_dict
+from dcr_tpu.core.metrics import LatencyTracker
+from dcr_tpu.serve.batcher import Batcher
+from dcr_tpu.serve.fleet import (FleetPaths, RequestJournal, WorkerLease,
+                                 clear_lease, fleet_paths, read_lease)
+from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
+                                 DrainingError, GenBucket, NoWorkersError,
+                                 Request, RequestQueue, SloShedError)
+from dcr_tpu.serve.worker import validate_bucket
+
+# worker slot states
+SPAWNING = "spawning"   # process launched, waiting for its lease
+ALIVE = "alive"         # lease observed, dispatch channel running
+BACKOFF = "backoff"     # died; respawn scheduled
+RETIRED = "retired"     # respawn budget exhausted — slot permanently down
+
+
+class RequestFailedError(RuntimeError):
+    """A request exhausted its dispatch attempts (every attempt lost its
+    worker) or its worker reported a per-request error — surfaced as the
+    future's exception, mapped to HTTP 500 by the front end."""
+
+
+# per-item worker errors (wire format "<TypeName>: <detail>") that describe
+# the WORKER's state, not the request: re-execution on a survivor succeeds,
+# so these requeue like a transport failure. Everything else (validation,
+# generation failure) would fail identically anywhere and becomes a typed
+# terminal failure.
+_RETRYABLE_ITEM_PREFIXES = ("DrainingError:", "QueueFullError:")
+
+
+def retryable_item_error(error: str) -> bool:
+    return error.startswith(_RETRYABLE_ITEM_PREFIXES)
+
+
+def _post_json(host: str, port: int, path: str, payload: dict,
+               timeout_s: float) -> tuple[int, dict]:
+    """One JSON POST over a fresh connection. The timeout is socket-level
+    (connect + each read), which bounds a dead/wedged peer; a trickling peer
+    is bounded by the worker's own watchdog instead."""
+    conn = http.client.HTTPConnection(host, port, timeout=timeout_s)
+    try:
+        body = json.dumps(payload).encode()
+        conn.request("POST", path, body=body,
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+class _WorkerSlot:
+    """Mutable per-slot record; state transitions happen under the
+    supervisor's lock (monitor thread and dispatch channels race on
+    death-detection)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.state = BACKOFF                 # start() spawns immediately
+        self.proc: Optional[subprocess.Popen] = None
+        self.lease: Optional[WorkerLease] = None
+        self.channel: Optional["DispatchChannel"] = None
+        self.consecutive_failures = 0
+        self.respawn_at = 0.0                # wall clock; 0 = due now
+        self.spawn_deadline = 0.0
+        self.alive_since = 0.0
+        self.incarnation = 0                 # spawn count, for log lines
+
+    def snapshot(self) -> dict:
+        lease = self.lease
+        return {
+            "index": self.index, "state": self.state,
+            "incarnation": self.incarnation,
+            "pid": self.proc.pid if self.proc is not None else None,
+            "port": lease.port if lease is not None else None,
+            "lease_age_s": round(lease.age_s(), 3) if lease is not None else None,
+            "consecutive_failures": self.consecutive_failures,
+        }
+
+
+class DispatchChannel:
+    """The per-worker dispatch loop: pull a bucket-coherent batch from the
+    shared queue, POST it to the worker, resolve futures from the response.
+    One batch in flight at a time; any transport failure requeues the batch
+    and reports the worker dead. The epilogue sweep requeues anything the
+    journal still shows in flight on this worker — belt-and-braces against a
+    channel dying between dispatch bookkeeping and the HTTP call."""
+
+    def __init__(self, supervisor: "FleetSupervisor", slot: _WorkerSlot,
+                 lease: WorkerLease):
+        self.supervisor = supervisor
+        self.slot = slot
+        self.index = slot.index
+        self.port = lease.port
+        self._stop = threading.Event()
+        self._dead = False                   # set (pre-stop) on worker death
+        cfg = supervisor.cfg
+        self._batcher = Batcher(cfg.max_batch, cfg.max_wait_ms / 1000.0)
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"fleet-dispatch:{self.index}.{slot.incarnation}")
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def mark_dead(self) -> None:
+        self._dead = True
+        self._stop.set()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def finished(self) -> bool:
+        return not self._thread.is_alive()
+
+    def join(self, timeout_s: float) -> None:
+        self._thread.join(timeout_s)
+
+    # -- the loop ------------------------------------------------------------
+
+    def _run(self) -> None:
+        sup = self.supervisor
+        try:
+            while True:
+                batch = self._batcher.next_batch(sup.queue, stop=self._stop)
+                if batch is None:
+                    break
+                if self._dead:
+                    # stop() raced the take: nothing was dispatched, so this
+                    # is a plain reinsertion (journal state is still QUEUED)
+                    sup.queue.requeue(batch)
+                    break
+                if not self._dispatch(batch):
+                    break
+        except Exception as e:
+            # a channel bug must surface as a worker failure (requeue +
+            # respawn), never a silently missing consumer
+            R.log_event("fleet_channel_error", worker=self.index, error=repr(e))
+            R.bump_counter("fleet_channel_errors")
+            sup._worker_failed(self.slot, f"dispatch channel error: {e!r}")
+        finally:
+            sup._sweep_orphans(self.index)
+
+    def _dispatch(self, batch: list[Request]) -> bool:
+        sup = self.supervisor
+        cfg = sup.cfg
+        t0 = time.monotonic()
+        now_wall = time.time()
+        send: list[Request] = []
+        for req in batch:
+            if sup.journal.dispatch(req.id, self.index) is None:
+                continue    # completed via a duplicate path while queued
+            waited = t0 - req.enqueued_at
+            sup.metrics.queue_wait.observe(waited)
+            tracing.complete_span(
+                "serve/queue_wait", start_wall=now_wall - waited,
+                dur_s=waited,
+                parent=req.span.id if req.span is not None else None,
+                request_id=req.id)
+            send.append(req)
+        if not send:
+            return True
+        b = send[0].bucket
+        payload = {"requests": [
+            {"prompt": r.prompt, "seed": r.seed, "resolution": b.resolution,
+             "steps": b.steps, "guidance": b.guidance, "sampler": b.sampler,
+             "rand_noise_lam": b.rand_noise_lam} for r in send]}
+        ids = [r.id for r in send]
+        with tracing.span("fleet/dispatch", worker=self.index,
+                          batch=len(send), request_ids=ids):
+            try:
+                status, doc = _post_json(
+                    cfg.host, self.port, "/generate_batch", payload,
+                    cfg.fleet.dispatch_timeout_s)
+            except (OSError, ValueError, http.client.HTTPException) as e:
+                sup._requeue(send, self.index, f"transport: {e!r}")
+                sup._worker_failed(self.slot, f"dispatch failed: {e!r}")
+                return False
+        results = doc.get("results") if status == 200 else None
+        if results is None or len(results) != len(send):
+            sup._requeue(send, self.index,
+                         f"bad dispatch response (status {status})")
+            sup._worker_failed(
+                self.slot, f"dispatch rejected: status {status} {doc!r}")
+            return False
+        retry: list[Request] = []
+        retry_reason = ""
+        for req, item in zip(send, results):
+            err = item.get("error")
+            if err is not None:
+                if retryable_item_error(err):
+                    # the worker rejected the item because of ITS state
+                    # (SIGTERM drain, local overload) — survivors can serve
+                    # it bit-identically; handled below, stays live
+                    retry.append(req)
+                    retry_reason = retry_reason or err
+                    continue
+                # a per-request error from a HEALTHY worker is not transient
+                # (typed validation/generation failure) — retrying it
+                # elsewhere would fail identically
+                if sup.journal.fail(req.id, err):
+                    sup.counter("failed").inc()
+                    req.future.set_exception(RequestFailedError(err))
+            else:
+                if sup.journal.ack(req.id, self.index):
+                    item["worker"] = self.index
+                    req.future.set_result(item)
+                    sup.counter("completed").inc()
+                else:
+                    sup.counter("duplicate_completions").inc()
+            sup._finish(req.id)
+        sup.counter("batches_dispatched").inc()
+        if retry:
+            # requeue FIRST (so the orphan sweep can't double-handle them),
+            # then retire this worker from dispatch: a draining worker is
+            # leaving membership, and redispatching to it from this channel
+            # would burn the requests' attempt budget in a tight loop
+            sup._requeue(retry, self.index,
+                         f"worker rejected items: {retry_reason}",
+                         charge=False)
+            sup._worker_failed(
+                self.slot,
+                f"rejected {len(retry)} item(s): {retry_reason}")
+            return False
+        return True
+
+
+class FleetSupervisor:
+    """Front-end-facing service (duck-compatible with
+    :class:`~dcr_tpu.serve.worker.GenerationService`: ``submit`` / ``status``
+    / ``default_bucket`` / ``draining``) plus the worker lifecycle engine.
+    ``serve/server.py``'s handler works against either."""
+
+    def __init__(self, cfg: ServeConfig,
+                 on_fatal: Optional[Callable[[], None]] = None):
+        if cfg.fleet.workers < 1:
+            raise ValueError("FleetSupervisor requires fleet.workers >= 1")
+        self.cfg = cfg
+        self.paths: FleetPaths = fleet_paths(cfg.fleet.dir).ensure()
+        self.queue = RequestQueue(cfg.queue_depth)
+        self.journal = RequestJournal(self.paths.journal)
+        self.metrics = _FleetMetrics()
+        self._on_fatal = on_fatal
+        self._requests: dict[int, Request] = {}   # live until terminal
+        self._requests_lock = threading.Lock()
+        self._admitted_buckets: set[GenBucket] = set()
+        self._buckets_lock = threading.Lock()
+        self._vae_scale: Optional[int] = None     # learned from first lease
+        self._draining = False
+        self._fatal = threading.Event()
+        self._shutdown = threading.Event()
+        self._lock = threading.Lock()             # slot state transitions
+        self._slots = [_WorkerSlot(i) for i in range(cfg.fleet.workers)]
+        self._poll_s = max(0.05, min(0.25, cfg.fleet.heartbeat_s / 2))
+        self._healthy_reset_s = max(10.0, 5 * cfg.fleet.heartbeat_s)
+        self._monitor: Optional[threading.Thread] = None
+
+    def counter(self, name: str):
+        return tracing.registry().counter(f"fleet/{name}")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        # one config file feeds every worker spawn: the full supervisor
+        # config with the role fields overridden per spawn on the CLI
+        self.paths.config.write_text(
+            json.dumps(to_dict(self.cfg), indent=2, sort_keys=True) + "\n")
+        for slot in self._slots:
+            self._spawn(slot)
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True, name="fleet-monitor")
+        self._monitor.start()
+
+    def _spawn(self, slot: _WorkerSlot) -> None:
+        f = self.cfg.fleet
+        clear_lease(self.paths, slot.index)   # a stale lease must never join
+        slot.incarnation += 1
+        argv = [sys.executable, "-m", "dcr_tpu.cli.serve",
+                f"--config={self.paths.config}",
+                "--fleet.workers=0",
+                f"--fleet.worker_index={slot.index}",
+                "--port=0"]
+        env = dict(os.environ)
+        # the `rank` fault coordinate of serve-side DCR_FAULTS kinds
+        env["DCR_WORKER_INDEX"] = str(slot.index)
+        try:
+            with open(self.paths.worker_log(slot.index), "ab") as logf:
+                slot.proc = subprocess.Popen(argv, stdout=logf,
+                                             stderr=subprocess.STDOUT, env=env)
+        except OSError as e:
+            R.log_event("fleet_spawn_error", worker=slot.index, error=repr(e))
+            R.bump_counter("fleet_spawn_errors")
+            self._spawn_failed(slot, f"spawn: {e!r}")
+            return
+        slot.state = SPAWNING
+        slot.spawn_deadline = time.time() + f.spawn_timeout_s
+        self.counter("workers_spawned").inc()
+        R.log_trace("fleet_spawn", worker=slot.index, pid=slot.proc.pid,
+                    incarnation=slot.incarnation)
+
+    def _worker_joined(self, slot: _WorkerSlot, lease: WorkerLease) -> None:
+        with self._lock:
+            if slot.state != SPAWNING:
+                return
+            slot.state = ALIVE
+            slot.lease = lease
+            slot.alive_since = time.time()
+            if self._vae_scale is None:
+                self._vae_scale = lease.vae_scale
+            slot.channel = DispatchChannel(self, slot, lease)
+        slot.channel.start()
+        R.log_trace("fleet_worker_joined", worker=slot.index, pid=lease.pid,
+                    port=lease.port, incarnation=slot.incarnation)
+
+    def _schedule_backoff_locked(self, slot: _WorkerSlot) -> bool:
+        """One failure tick (caller holds ``self._lock``): bump the streak,
+        move the slot to BACKOFF with bounded exponential delay — or RETIRED
+        past the respawn budget. Returns whether the slot retired. The ONLY
+        place the backoff/retire policy lives; runtime deaths and spawn
+        failures must never drift apart."""
+        f = self.cfg.fleet
+        slot.consecutive_failures += 1
+        delay = min(f.respawn_max_delay_s,
+                    f.respawn_base_delay_s
+                    * (2 ** (slot.consecutive_failures - 1)))
+        slot.respawn_at = time.time() + delay
+        retire = slot.consecutive_failures > f.respawn_max
+        slot.state = RETIRED if retire else BACKOFF
+        return retire
+
+    def _worker_failed(self, slot: _WorkerSlot, reason: str) -> None:
+        """First caller wins (monitor vs dispatch channel race); moves the
+        slot to BACKOFF (or RETIRED), kills any remaining process, and lets
+        the channel's error path / epilogue sweep requeue the in-flight
+        work."""
+        with self._lock:
+            if slot.state not in (ALIVE, SPAWNING):
+                return
+            rc = slot.proc.poll() if slot.proc is not None else None
+            slot.lease = None
+            retire = self._schedule_backoff_locked(slot)
+        self.counter("workers_lost").inc()
+        R.log_event("fleet_worker_lost", worker=slot.index, reason=reason,
+                    rc=rc, consecutive_failures=slot.consecutive_failures,
+                    retired=retire)
+        if slot.channel is not None:
+            slot.channel.mark_dead()
+        if slot.proc is not None and slot.proc.poll() is None:
+            # frozen or wedged, not dead: SIGKILL also breaks the channel's
+            # in-flight HTTP call, which is what triggers the requeue
+            try:
+                slot.proc.kill()
+            except OSError as e:
+                R.log_event("fleet_kill_error", worker=slot.index,
+                            error=repr(e))
+                R.bump_counter("fleet_kill_errors")
+        clear_lease(self.paths, slot.index)
+        if retire:
+            R.log_event("fleet_slot_retired", worker=slot.index,
+                        failures=slot.consecutive_failures)
+
+    def _spawn_failed(self, slot: _WorkerSlot, reason: str) -> None:
+        if slot.proc is not None and slot.proc.poll() is None:
+            try:
+                slot.proc.kill()
+            except OSError as e:
+                R.log_event("fleet_kill_error", worker=slot.index,
+                            error=repr(e))
+                R.bump_counter("fleet_kill_errors")
+        with self._lock:
+            retire = self._schedule_backoff_locked(slot)
+        R.log_event("fleet_spawn_failed", worker=slot.index, reason=reason,
+                    retired=retire)
+
+    def _monitor_loop(self) -> None:
+        while not self._shutdown.wait(self._poll_s):
+            now = time.time()
+            alive = 0
+            for slot in self._slots:
+                state = slot.state
+                if state == ALIVE:
+                    rc = slot.proc.poll()
+                    lease = read_lease(self.paths, slot.index)
+                    if rc is not None:
+                        self._worker_failed(slot, f"process exited rc={rc}")
+                    elif lease is None or lease.expired(now):
+                        age = lease.age_s(now) if lease is not None else None
+                        self._worker_failed(
+                            slot, f"lease lapsed (age {age}s) — frozen worker")
+                    else:
+                        # re-check under the lock: a dispatch channel may
+                        # have moved the slot to BACKOFF since the unlocked
+                        # state read above — writing lease/streak then would
+                        # pin a live-looking lease onto a dead slot and lose
+                        # a failure increment
+                        with self._lock:
+                            if slot.state == ALIVE:
+                                slot.lease = lease
+                                alive += 1
+                                if (slot.consecutive_failures
+                                        and now - slot.alive_since
+                                        > self._healthy_reset_s):
+                                    slot.consecutive_failures = 0
+                elif state == SPAWNING:
+                    rc = slot.proc.poll()
+                    lease = read_lease(self.paths, slot.index)
+                    if lease is not None and lease.pid == slot.proc.pid:
+                        self._worker_joined(slot, lease)
+                        alive += 1
+                    elif rc is not None:
+                        self._spawn_failed(
+                            slot, f"exited rc={rc} before publishing a lease")
+                    elif now > slot.spawn_deadline:
+                        self._spawn_failed(slot, "no lease within "
+                                           f"{self.cfg.fleet.spawn_timeout_s}s")
+                elif state == BACKOFF:
+                    channel_done = (slot.channel is None
+                                    or slot.channel.finished())
+                    # a drain suppresses respawns ONLY once the backlog is
+                    # gone: if the last worker dies mid-drain with accepted
+                    # requests still requeued, refusing to respawn would
+                    # strand them until the shutdown timeout 500s them —
+                    # breaking "every accepted request receives its response"
+                    if (channel_done and now >= slot.respawn_at
+                            and (not self._draining
+                                 or self.journal.pending_count() > 0)):
+                        # the old incarnation's channel has fully unwound
+                        # (its orphan sweep ran), so requeue/dispatch can't
+                        # race the fresh incarnation
+                        with tracing.span("fleet/respawn", worker=slot.index,
+                                          failures=slot.consecutive_failures):
+                            self.counter("respawns").inc()
+                            self._spawn(slot)
+            tracing.registry().gauge("fleet/workers_alive").set(float(alive))
+            if (alive == 0
+                    and all(s.state == RETIRED for s in self._slots)
+                    and not self._fatal.is_set()):
+                self._fail_fleet()
+
+    def _fail_fleet(self) -> None:
+        """Every slot exhausted its respawn budget: fail pending work loudly
+        and leave a post-mortem, instead of a healthy-looking port whose
+        queue never drains."""
+        self._fatal.set()
+        R.log_event("fleet_failed", workers=self.cfg.fleet.workers,
+                    pending=self.journal.pending_count())
+        with self._requests_lock:
+            pending = list(self._requests.values())
+        for req in pending:
+            if self.journal.fail(req.id, "fleet failed: all slots retired"):
+                self.counter("failed").inc()
+                if not req.future.done():
+                    req.future.set_exception(RequestFailedError(
+                        "fleet failed: every worker slot exhausted its "
+                        "respawn budget"))
+            self._finish(req.id)
+        tracing.dump_flight_recorder("fleet_failed: all worker slots retired")
+        if self._on_fatal is not None:
+            self._on_fatal()
+
+    # -- requeue / bookkeeping ----------------------------------------------
+
+    def _requeue(self, reqs: list[Request], worker: int, reason: str,
+                 charge: bool = True) -> None:
+        """Journaled IN_FLIGHT -> QUEUED for a dead worker's batch; requests
+        past the attempt budget become typed failures instead (still never a
+        silent drop — the journal records which). ``charge=False`` refunds
+        the dispatch (worker-state rejection: the request never executed),
+        so a rolling restart can't exhaust a request's budget with bounces
+        that a survivor would serve identically."""
+        keep: list[Request] = []
+        with tracing.span("serve/requeue", worker=worker, n=len(reqs),
+                          reason=reason):
+            for req in reqs:
+                attempts = self.journal.requeue(req.id, worker, reason,
+                                                charge=charge)
+                if attempts >= self.cfg.fleet.max_attempts:
+                    if self.journal.fail(
+                            req.id, f"attempts exhausted ({attempts})"):
+                        self.counter("failed").inc()
+                        if not req.future.done():
+                            req.future.set_exception(RequestFailedError(
+                                f"request lost its worker {attempts} times "
+                                f"(last: {reason})"))
+                    self._finish(req.id)
+                else:
+                    keep.append(req)
+                    self.counter("requeued").inc()
+            self.queue.requeue(keep)
+        R.log_event("serve_requeue", worker=worker, n=len(keep),
+                    failed=len(reqs) - len(keep), reason=reason)
+
+    def _sweep_orphans(self, worker: int) -> None:
+        """Requeue whatever the journal still shows in flight on a stopped
+        worker — normally empty (the channel's error path already ran)."""
+        ids = self.journal.inflight_for(worker)
+        if not ids:
+            return
+        with self._requests_lock:
+            reqs = [self._requests[i] for i in ids if i in self._requests]
+        if reqs:
+            self._requeue(reqs, worker, "orphan sweep after worker loss")
+
+    def _finish(self, req_id: int) -> None:
+        with self._requests_lock:
+            self._requests.pop(req_id, None)
+
+    # -- admission (front-end facing) ----------------------------------------
+
+    def default_bucket(self) -> GenBucket:
+        c = self.cfg
+        return GenBucket(resolution=c.resolution, steps=c.num_inference_steps,
+                         guidance=c.guidance_scale, sampler=c.sampler,
+                         rand_noise_lam=c.rand_noise_lam)
+
+    def _check_shed(self) -> None:
+        f = self.cfg.fleet
+        if f.slo_queue_wait_p99_s <= 0:
+            return
+        # shedding needs BOTH a breached p99 and a live backlog: the p99
+        # window only refreshes while requests flow, so without the depth
+        # gate a single bad burst would latch the shed forever
+        if self.queue.depth() < self.cfg.max_batch:
+            return
+        p99 = self.metrics.queue_wait.percentiles((99,)).get("p99", 0.0)
+        if p99 > f.slo_queue_wait_p99_s:
+            self.counter("shed").inc()
+            raise SloShedError(
+                f"queue-wait p99 {p99:.2f}s over SLO "
+                f"{f.slo_queue_wait_p99_s:.2f}s — shedding",
+                retry_after_s=f.shed_retry_after_s)
+
+    def submit(self, prompt: str, *, seed: int = 0,
+               bucket: Optional[GenBucket] = None) -> Request:
+        """Admit into the fleet queue. Same typed-rejection contract as
+        GenerationService.submit, plus :class:`SloShedError` (503 +
+        Retry-After) and :class:`NoWorkersError` (fleet warming/failed)."""
+        f = self.cfg.fleet
+        bucket = bucket or self.default_bucket()
+        try:
+            if self._draining:
+                raise DrainingError(
+                    "service is draining; not accepting requests")
+            if self._fatal.is_set():
+                raise NoWorkersError(
+                    "fleet failed: every worker slot is retired",
+                    retry_after_s=f.shed_retry_after_s)
+            if self._vae_scale is None:
+                raise NoWorkersError(
+                    "no worker has joined yet (fleet warming up)",
+                    retry_after_s=f.shed_retry_after_s)
+            validate_bucket(bucket, vae_scale=self._vae_scale)
+            self._check_shed()      # before the bucket is registered
+            with self._buckets_lock:
+                bucket_added = bucket not in self._admitted_buckets
+                if (bucket_added and len(self._admitted_buckets)
+                        >= self.cfg.max_compiled_buckets):
+                    raise BucketLimitError(
+                        f"bucket {bucket} would exceed the resident "
+                        f"compiled-sampler budget "
+                        f"({self.cfg.max_compiled_buckets}) on every worker")
+                self._admitted_buckets.add(bucket)
+            req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
+                          bucket=bucket)
+            root = tracing.begin_span("serve/request", parent=None,
+                                      request_id=req.id, seed=req.seed,
+                                      bucket=str(tuple(bucket)))
+            req.span = root
+            with self._requests_lock:
+                self._requests[req.id] = req
+            # journal BEFORE queue: a dispatch channel may pop the request
+            # the instant it is published, and must find it journaled
+            self.journal.add(req)
+            try:
+                self.queue.submit(req)
+            except AdmissionError:
+                self.journal.reject(req.id, "queue rejected admission")
+                self._finish(req.id)
+                # a never-dispatched novel bucket must not consume a
+                # compiled-sampler slot forever. Kept when any live request
+                # still carries it (the rare concurrent-admit race then at
+                # worst over-counts by the one slot we leave registered)
+                if bucket_added:
+                    with self._requests_lock:
+                        in_use = any(r.bucket == bucket
+                                     for r in self._requests.values())
+                    if not in_use:
+                        with self._buckets_lock:
+                            self._admitted_buckets.discard(bucket)
+                raise
+            if self._fatal.is_set():
+                # raced _fail_fleet: its one-shot sweep may have snapshotted
+                # _requests before this insert, leaving a request no retired
+                # channel will ever pop and no sweep will ever fail. Make it
+                # terminal here and reject admission with the same typed 503
+                # the pre-check gives.
+                try:
+                    self.journal.reject(req.id, "fleet failed during admission")
+                except ValueError:
+                    pass            # the sweep got there first: already terminal
+                self._finish(req.id)
+                raise NoWorkersError(
+                    "fleet failed: every worker slot is retired",
+                    retry_after_s=f.shed_retry_after_s)
+        except AdmissionError as e:
+            self.metrics.note_rejected(e)
+            tracing.event("serve/rejected", error=type(e).__name__)
+            raise
+        self.counter("accepted").inc()
+        enq = req.enqueued_at
+        req.future.add_done_callback(
+            lambda fut: self._request_done(root, enq, fut))
+        return req
+
+    def _request_done(self, root, enqueued_at: float, fut) -> None:
+        if fut.exception() is not None:
+            root.end(error=repr(fut.exception()))
+        else:
+            self.metrics.latency.observe(time.monotonic() - enqueued_at)
+            root.end()
+
+    # -- drain / shutdown ----------------------------------------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def fatal(self) -> bool:
+        """True once every worker slot retired and pending work was failed —
+        the front end should exit nonzero, not 83-restart-me."""
+        return self._fatal.is_set()
+
+    def health(self) -> str:
+        if self._fatal.is_set():
+            return "failed"
+        if self._draining:
+            return "draining"
+        if self._vae_scale is None:
+            return "warming"
+        return "ok"
+
+    def begin_drain(self) -> None:
+        """Stop admission. The shared queue is NOT closed: requeues of
+        already-accepted work must keep landing while channels drain the
+        backlog."""
+        self._draining = True
+        R.log_trace("fleet_drain_begin", pending=self.journal.pending_count())
+
+    def join_drained(self, timeout_s: float) -> bool:
+        """Wait until every accepted request reached a terminal state."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.journal.pending_count() == 0:
+                return True
+            if self._fatal.is_set():
+                return self.journal.pending_count() == 0
+            time.sleep(self._poll_s)
+        return self.journal.pending_count() == 0
+
+    def shutdown(self, timeout_s: float = 60.0) -> None:
+        """Stop channels, SIGTERM workers (their own drain -> exit 83), then
+        reap. Call after :meth:`join_drained`; anything still pending at
+        this point gets a typed failure, not silence."""
+        self._shutdown.set()
+        for slot in self._slots:
+            if slot.channel is not None:
+                slot.channel.stop()
+        # one shared deadline across all channel joins (same pattern as the
+        # proc reap below): N wedged channels must not serialize into
+        # N x timeout_s before workers even see SIGTERM
+        join_deadline = time.monotonic() + timeout_s
+        for slot in self._slots:
+            if slot.channel is not None:
+                slot.channel.join(
+                    max(0.1, join_deadline - time.monotonic()))
+        with self._requests_lock:
+            leftovers = list(self._requests.values())
+        for req in leftovers:
+            if self.journal.fail(req.id, "supervisor shutdown"):
+                self.counter("failed").inc()
+                if not req.future.done():
+                    req.future.set_exception(RequestFailedError(
+                        "supervisor shut down before the request completed"))
+            self._finish(req.id)
+        for slot in self._slots:
+            if slot.proc is not None and slot.proc.poll() is None:
+                try:
+                    slot.proc.send_signal(signal.SIGTERM)
+                except OSError as e:
+                    R.log_event("fleet_term_error", worker=slot.index,
+                                error=repr(e))
+                    R.bump_counter("fleet_term_errors")
+        deadline = time.monotonic() + timeout_s
+        for slot in self._slots:
+            if slot.proc is None:
+                continue
+            try:
+                slot.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                R.log_event("fleet_worker_drain_timeout", worker=slot.index)
+                try:
+                    slot.proc.kill()
+                    slot.proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired) as e:
+                    R.log_event("fleet_kill_error", worker=slot.index,
+                                error=repr(e))
+                    R.bump_counter("fleet_kill_errors")
+        if self._monitor is not None:
+            self._monitor.join(timeout=5 * self._poll_s)
+        self.journal.close()
+
+    # -- introspection -------------------------------------------------------
+
+    def status(self) -> dict:
+        d = {
+            "role": "supervisor",
+            "health": self.health(),
+            "draining": self._draining,
+            "queue_depth": self.queue.depth(),
+            "workers": [s.snapshot() for s in self._slots],
+            "workers_alive": sum(1 for s in self._slots if s.state == ALIVE),
+            "journal": self.journal.counts(),
+            "fleet": {k[len("fleet/"):]: v for k, v in
+                      tracing.registry().counters("fleet/").items()},
+        }
+        d["latency_ms"] = {k: round(v * 1000.0, 3) for k, v in
+                           self.metrics.latency.percentiles((50, 99)).items()}
+        d["queue_wait_ms"] = {k: round(v * 1000.0, 3) for k, v in
+                              self.metrics.queue_wait.percentiles((50, 99)).items()}
+        return d
+
+
+class _FleetMetrics:
+    """Latency/queue-wait reservoirs plus the typed-rejection counters; the
+    monotonic fleet counters live directly in the telemetry registry
+    (``dcr_fleet_*`` in Prometheus text)."""
+
+    def __init__(self):
+        self.latency = LatencyTracker(name="fleet/request_latency_s")
+        self.queue_wait = LatencyTracker(name="fleet/queue_wait_s")
+
+    def note_rejected(self, error: AdmissionError) -> None:
+        tracing.registry().counter(
+            f"fleet/rejected_{type(error).__name__}").inc()

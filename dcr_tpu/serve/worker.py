@@ -23,6 +23,8 @@ core, HTTP-free so benches and tests drive it in-process:
 from __future__ import annotations
 
 import logging
+import os
+import signal
 import threading
 import time
 from typing import Optional
@@ -275,6 +277,9 @@ class GenerationService:
         self._uncond: Optional[np.ndarray] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # per-process batch index: the `batch` coordinate of the serve-side
+        # fault kinds (worker_crash / worker_hang / slow_step)
+        self._batch_index = 0
 
     # -- request plumbing ----------------------------------------------------
 
@@ -434,9 +439,30 @@ class GenerationService:
         hang_abort("serve_batch",
                    detail=f"sampler step exceeded {self.cfg.hang_timeout_s}s")
 
+    def _inject_batch_faults(self, batch_index: int) -> None:
+        """Serve-side deterministic fault hooks (utils/faults.py), fired
+        inside the batch watchdog window so a wedge is caught by the same
+        machinery a real one would be. ``worker_crash`` is a true SIGKILL —
+        no drain, no flush, no exit handler — because that is the death a
+        fleet supervisor must requeue around; ``worker_hang`` wedges this
+        thread exactly like a dead collective; ``slow_step`` is a straggler
+        (DCR_SLOW_STEP_S, default 30s) for latency/SLO chaos."""
+        from dcr_tpu.utils import faults
+
+        if faults.fire("worker_crash", batch=batch_index):
+            os.kill(os.getpid(), signal.SIGKILL)
+        if faults.fire("worker_hang", batch=batch_index):
+            from dcr_tpu.core.coordination import simulate_hang
+
+            simulate_hang(f"worker_hang@batch={batch_index}")
+        if faults.fire("slow_step", batch=batch_index):
+            time.sleep(float(os.environ.get("DCR_SLOW_STEP_S", "30")))
+
     def _process(self, batch: list[Request]) -> None:
         t0 = time.monotonic()
         now_wall = time.time()
+        batch_index = self._batch_index
+        self._batch_index += 1
         for req in batch:
             # queue wait measured from the admission stamp, recorded
             # retroactively under the request's root span: the number the
@@ -451,6 +477,7 @@ class GenerationService:
             # post-mortem + EXIT_HANG instead of a silently dead port
             with R.watchdog("serve:batch", self.cfg.hang_timeout_s,
                             on_timeout=self._on_hang):
+                self._inject_batch_faults(batch_index)
                 images = self.execute(batch)
         except Exception as e:
             R.log_event("serve_batch_failed", batch=len(batch),

@@ -27,6 +27,22 @@ coordinated-recovery tests. Supported kinds and their hook points:
 - ``hang`` — Trainer loop, coord ``step``: wedges the host thread forever
   (a dead peer inside a collective), driving the hang-watchdog abort path
   (core/coordination.py).
+- ``worker_crash`` — serve batch loop, coord ``batch`` (per-process batch
+  index): SIGKILLs the serving process mid-batch — the abrupt death a fleet
+  supervisor must requeue around (no drain, no flush, no exit handler).
+- ``worker_hang`` — serve batch loop, coord ``batch``: wedges the worker
+  thread inside the batch watchdog window, driving the exit-89 path (or the
+  supervisor's dispatch-timeout kill when the watchdog is disabled).
+- ``slow_step`` — serve batch loop, coord ``batch``: sleeps
+  ``DCR_SLOW_STEP_S`` (default 30) seconds before the device step — a
+  straggler, for latency/SLO chaos rather than death.
+
+In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
+supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and
+that takes precedence over ``jax.process_index()`` (every fleet worker is
+its own single-process jax runtime, so process_index alone would pin all
+faults to 0). ``worker_crash@batch=1&rank=0`` kills fleet worker 0 during
+its second batch.
 
 The registry is process-global, parsed once from ``DCR_FAULTS`` (tests use
 :func:`install`/:func:`clear`), thread-safe (loader workers fire
@@ -55,7 +71,13 @@ _ENTRY_RE = re.compile(r"^(?P<kind>[a-z_]+)@(?P<coords>[a-z_]+=\d+(?:[&@][a-z_]+
 
 
 def _current_rank() -> int:
-    """The implicit ``rank`` coordinate for ``@rank=`` targeting."""
+    """The implicit ``rank`` coordinate for ``@rank=`` targeting. Fleet
+    worker index (DCR_WORKER_INDEX, exported by the serve supervisor) wins
+    over ``jax.process_index()``: fleet workers are independent
+    single-process jax runtimes, all process_index 0."""
+    worker = os.environ.get("DCR_WORKER_INDEX")
+    if worker:
+        return int(worker)
     try:
         import jax
 

@@ -1,0 +1,483 @@
+"""dcr-fleet tests: request-level fault tolerance for multi-worker serve.
+
+Fast tier: pure-logic units for the request journal's state machine (the
+zero-drop ledger), head-insertion requeue on the shared queue, lease
+publish/expiry/corruption handling, fleet config validation, and the typed
+admission -> HTTP response mapping (Retry-After on shed/no-workers).
+
+Slow tier: the acceptance e2e — a real ``dcr-serve --fleet.workers=2``
+supervisor subprocess (which spawns two real worker subprocesses), an
+injected ``worker_crash`` SIGKILLing worker 0 on its first batch, and the
+assertion that every accepted request still completes with a response
+bit-identical to an uninjected run, with the durable journal replaying to
+zero dropped requests. Launch plumbing (free ports, env) is shared with
+tests/_multiproc.py and tests/test_serve.py; the fleet needs no
+jax.distributed rendezvous — the supervisor spawns its own workers and the
+control plane is the lease directory, so the two-process launcher itself is
+not used.
+"""
+
+import json
+import time
+
+import pytest
+
+from dcr_tpu.core.config import FleetConfig, ServeConfig, validate_serve_config
+from dcr_tpu.serve.fleet import (ACKED, FAILED, IN_FLIGHT, QUEUED, FleetPaths,
+                                 RequestJournal, WorkerLease,
+                                 bucket_from_tuple, clear_lease, fleet_paths,
+                                 read_lease, write_lease)
+from dcr_tpu.serve.queue import (GenBucket, NoWorkersError, QueueFullError,
+                                 Request, RequestQueue, SloShedError)
+from dcr_tpu.serve.server import admission_response
+
+
+def _bucket(**kw) -> GenBucket:
+    d = dict(resolution=16, steps=2, guidance=7.5, sampler="ddim",
+             rand_noise_lam=0.0)
+    d.update(kw)
+    return GenBucket(**d)
+
+
+def _req(prompt="p", seed=0) -> Request:
+    return Request(prompt=prompt, seed=seed, bucket=_bucket())
+
+
+# ---------------------------------------------------------------------------
+# request journal: the zero-drop state machine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_journal_happy_path_and_counts():
+    j = RequestJournal()
+    r = _req()
+    e = j.add(r)
+    assert e.state == QUEUED and e.attempts == 0
+    assert j.dispatch(r.id, worker=1) == 1
+    assert j.entry(r.id).state == IN_FLIGHT
+    assert j.inflight_for(1) == [r.id]
+    assert j.ack(r.id, worker=1) is True
+    assert j.entry(r.id).state == ACKED
+    assert j.pending_count() == 0
+    c = j.counts()
+    assert c["accepted"] == 1 and c[ACKED] == 1 and c["requeued_total"] == 0
+
+
+@pytest.mark.fast
+def test_journal_requeue_ordering_and_attempts():
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    assert j.dispatch(r.id, worker=0) == 1
+    # worker 0 died: back to QUEUED, attempt count preserved
+    assert j.requeue(r.id, worker=0, reason="crash") == 1
+    assert j.entry(r.id).state == QUEUED
+    assert j.inflight_for(0) == []
+    # re-dispatch elsewhere is attempt 2
+    assert j.dispatch(r.id, worker=1) == 2
+    assert j.ack(r.id, worker=1) is True
+    assert j.counts()["requeued_total"] == 1
+
+
+@pytest.mark.fast
+def test_journal_uncharged_requeue_refunds_attempt_budget():
+    """A worker-state rejection (draining/overloaded) never executed the
+    request, so it must not burn max_attempts: three bounces off draining
+    workers still leave the budget at zero, while real losses charge it."""
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    for worker in range(3):
+        j.dispatch(r.id, worker=worker)
+        assert j.requeue(r.id, worker=worker, reason="DrainingError: bye",
+                         charge=False) == 0
+    j.dispatch(r.id, worker=3)
+    assert j.requeue(r.id, worker=3, reason="crash") == 1   # charged
+    assert j.entry(r.id).attempts == 4                      # audit trail kept
+
+
+@pytest.mark.fast
+def test_journal_compacts_terminal_entries():
+    """Terminal entries leave the live map (bounded memory in a long-lived
+    supervisor) but stay addressable for duplicate-ack dedup and counts."""
+    j = RequestJournal()
+    reqs = [_req(seed=i) for i in range(5)]
+    for r in reqs:
+        j.add(r)
+        j.dispatch(r.id, worker=0)
+        assert j.ack(r.id, worker=0) is True
+    assert j.pending_count() == 0
+    assert len(j._entries) == 0                # live map fully drained
+    assert j.entry(reqs[0].id).state == ACKED  # still addressable
+    assert j.entry(reqs[0].id).prompt == ""    # heavy field dropped
+    assert j.ack(reqs[0].id, worker=1) is False
+    c = j.counts()
+    assert c["accepted"] == 5 and c[ACKED] == 5 and c["duplicate_acks"] == 1
+
+
+@pytest.mark.fast
+def test_journal_no_duplicate_completion():
+    """First completion wins: the requeued twin's late result is dropped."""
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    j.dispatch(r.id, worker=0)
+    j.requeue(r.id, worker=0, reason="presumed dead")
+    j.dispatch(r.id, worker=1)
+    assert j.ack(r.id, worker=1) is True       # winner
+    assert j.ack(r.id, worker=0) is False      # zombie worker 0 delivered late
+    assert j.fail(r.id, "too late") is False   # and can't be failed either
+    c = j.counts()
+    assert c["duplicate_acks"] == 1 and c[ACKED] == 1 and c[FAILED] == 0
+
+
+@pytest.mark.fast
+def test_journal_dispatch_of_terminal_entry_returns_none():
+    """A requeued copy still sitting in the queue after its twin completed
+    must be skipped at dispatch time, not re-executed."""
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    j.dispatch(r.id, worker=0)
+    j.ack(r.id, worker=0)
+    assert j.dispatch(r.id, worker=1) is None
+
+
+@pytest.mark.fast
+def test_journal_invalid_transitions_raise():
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    with pytest.raises(ValueError):
+        j.add(r)                               # double add
+    with pytest.raises(ValueError):
+        j.requeue(r.id, worker=0, reason="x")  # requeue of QUEUED
+    j.dispatch(r.id, worker=0)
+    with pytest.raises(ValueError):
+        j.dispatch(r.id, worker=1)             # double dispatch
+    with pytest.raises(ValueError):
+        j.reject(r.id, "x")                    # reject after dispatch
+
+
+@pytest.mark.fast
+def test_journal_reject_rolls_back_admission():
+    j = RequestJournal()
+    r = _req()
+    j.add(r)
+    j.reject(r.id, "queue full")
+    assert j.entry(r.id) is None
+    assert j.counts()["accepted"] == 0
+    j.reject(r.id, "again")                    # idempotent on absent ids
+
+
+@pytest.mark.fast
+def test_journal_replay_from_durable_file(tmp_path):
+    """The acceptance arithmetic reads the JSONL alone: requeues, duplicate
+    acks, a terminal failure, a rejected admission, and one request the
+    supervisor lost track of (still QUEUED) -> dropped = 1."""
+    path = tmp_path / "journal.jsonl"
+    j = RequestJournal(path)
+    a, b, c, d = _req(), _req(), _req(), _req()
+    j.add(a); j.dispatch(a.id, 0); j.requeue(a.id, 0, "crash")
+    j.dispatch(a.id, 1); j.ack(a.id, 1); j.ack(a.id, 0)   # + duplicate
+    j.add(b); j.dispatch(b.id, 1); j.fail(b.id, "attempts exhausted")
+    j.add(c); j.reject(c.id, "queue full")                # never accepted
+    j.add(d)                                              # lost: still QUEUED
+    j.close()
+
+    replay = RequestJournal.replay(path)
+    counts = replay["counts"]
+    assert replay["states"][a.id] == ACKED
+    assert replay["states"][b.id] == FAILED
+    assert c.id not in replay["states"]
+    assert counts["accepted"] == 3
+    assert counts["requeued_total"] == 1
+    assert counts["duplicate_acks"] == 1
+    assert counts["dropped"] == 1              # d was accepted, never resolved
+    # every line is valid JSON with an op and a timestamp (the audit trail
+    # external tools consume)
+    for line in path.read_text().splitlines():
+        rec = json.loads(line)
+        assert "op" in rec and "t" in rec
+
+
+@pytest.mark.fast
+def test_journal_rotates_previous_incarnation(tmp_path):
+    """Request ids restart per supervisor process, so a restarted supervisor
+    must never append onto the previous run's journal — replay would merge
+    two id spaces and corrupt the zero-drop arithmetic."""
+    path = tmp_path / "journal.jsonl"
+    j1 = RequestJournal(path)
+    r1 = _req()
+    j1.add(r1); j1.dispatch(r1.id, 0); j1.ack(r1.id, 0)
+    j1.close()
+
+    j2 = RequestJournal(path)          # "restart": same --fleet.dir
+    r2 = _req()
+    j2.add(r2)                         # run 2: accepted, never resolved
+    j2.close()
+
+    counts = RequestJournal.replay(path)["counts"]
+    assert counts["accepted"] == 1     # run 1's records are NOT merged in
+    assert counts["dropped"] == 1      # and run 2's pending request shows
+    rotated = [p for p in tmp_path.iterdir()
+               if p.name.startswith("journal.jsonl.")]
+    assert len(rotated) == 1           # run 1 preserved for audit
+    assert RequestJournal.replay(rotated[0])["counts"]["dropped"] == 0
+
+
+@pytest.mark.fast
+def test_queue_requeue_head_insertion_survives_drain():
+    q = RequestQueue(maxsize=4)
+    rs = [_req(seed=i) for i in range(4)]
+    for r in rs:
+        q.submit(r)
+    taken = q.take_group(2)                    # worker took [0, 1] and died
+    assert [r.seed for r in taken] == [0, 1]
+    stamps = [r.enqueued_at for r in taken]
+    q.close()                                  # drain began meanwhile
+    with pytest.raises(Exception):
+        q.submit(_req(seed=9))                 # admission IS closed...
+    q.requeue(taken)                           # ...but requeue must land
+    assert [r.seed for r in q.take_group(8)] == [0, 1, 2, 3]
+    assert stamps == [r.enqueued_at for r in taken]   # true wait preserved
+    # full queue: requeue bypasses the bound too (these were admitted once)
+    q2 = RequestQueue(maxsize=1)
+    q2.submit(_req(seed=0))
+    q2.requeue([_req(seed=1)])
+    assert q2.depth() == 2
+
+
+# ---------------------------------------------------------------------------
+# leases
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_lease_roundtrip_expiry_and_clear(tmp_path):
+    paths = fleet_paths(tmp_path).ensure()
+    lease = WorkerLease(index=2, pid=4242, port=18000, vae_scale=8,
+                        lease_s=5.0)
+    write_lease(paths, lease)
+    got = read_lease(paths, 2)
+    assert got == lease
+    assert not got.expired()
+    assert got.expired(now=time.time() + 6.0)   # silent past lease_s = dead
+    assert got.age_s(now=got.renewed_at + 1.5) == pytest.approx(1.5)
+    clear_lease(paths, 2)
+    assert read_lease(paths, 2) is None
+    clear_lease(paths, 2)                       # idempotent
+
+
+@pytest.mark.fast
+def test_lease_corrupt_file_reads_as_absent(tmp_path):
+    paths = FleetPaths(tmp_path).ensure()
+    paths.lease_file(0).write_text("{not json")
+    assert read_lease(paths, 0) is None
+    paths.lease_file(1).write_text('{"unexpected": "fields"}')
+    assert read_lease(paths, 1) is None
+    assert read_lease(paths, 7) is None         # absent
+
+
+@pytest.mark.fast
+def test_bucket_from_tuple_roundtrip():
+    b = _bucket(guidance=3.5, sampler="dpm++")
+    assert bucket_from_tuple(tuple(b)) == b
+    assert bucket_from_tuple(list(b)) == b
+
+
+# ---------------------------------------------------------------------------
+# config validation + typed rejection -> HTTP mapping
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_fleet_config_validation():
+    validate_serve_config(ServeConfig())                       # role off: ok
+    validate_serve_config(ServeConfig(fleet=FleetConfig(workers=2)))
+    with pytest.raises(ValueError, match="mutually"):
+        validate_serve_config(ServeConfig(
+            fleet=FleetConfig(workers=2, worker_index=0)))
+    with pytest.raises(ValueError, match="lease_s"):
+        validate_serve_config(ServeConfig(
+            fleet=FleetConfig(workers=1, heartbeat_s=2.0, lease_s=1.0)))
+    with pytest.raises(ValueError, match="dispatch_timeout_s"):
+        validate_serve_config(ServeConfig(
+            fleet=FleetConfig(workers=1, dispatch_timeout_s=0.0)))
+    with pytest.raises(ValueError, match="max_attempts"):
+        validate_serve_config(ServeConfig(
+            fleet=FleetConfig(workers=1, max_attempts=0)))
+    # a worker role validates the shared lease contract too
+    with pytest.raises(ValueError, match="lease_s"):
+        validate_serve_config(ServeConfig(
+            fleet=FleetConfig(worker_index=0, heartbeat_s=0.0)))
+
+
+@pytest.mark.fast
+def test_admission_response_mapping():
+    code, payload, headers = admission_response(
+        SloShedError("p99 over SLO", retry_after_s=7.0))
+    assert code == 503 and payload["error"] == "shed"
+    assert headers["Retry-After"] == "7"
+    code, payload, headers = admission_response(
+        NoWorkersError("warming", retry_after_s=0.2))
+    assert code == 503 and payload["error"] == "no_workers"
+    assert headers["Retry-After"] == "1"        # floor: never Retry-After: 0
+    code, payload, headers = admission_response(QueueFullError("full"))
+    assert code == 503 and payload["error"] == "overloaded"
+    assert "Retry-After" not in headers
+    from dcr_tpu.serve.queue import InvalidRequestError
+
+    code, payload, _ = admission_response(InvalidRequestError("bad steps"))
+    assert code == 400 and "bad steps" in payload["error"]
+
+
+@pytest.mark.fast
+def test_retryable_item_error_classification():
+    """Worker-state rejections (drain, local overload) requeue on survivors;
+    request-shaped failures are terminal wherever they run."""
+    from dcr_tpu.serve.supervisor import retryable_item_error
+
+    assert retryable_item_error("DrainingError: service is draining")
+    assert retryable_item_error("QueueFullError: queue is full")
+    assert not retryable_item_error("InvalidRequestError: bad steps")
+    assert not retryable_item_error("BucketLimitError: budget")
+    assert not retryable_item_error("RuntimeError: generation failed")
+
+
+@pytest.mark.fast
+def test_rejected_request_does_not_leak_bucket_slot(tmp_path):
+    """A novel bucket on a request the queue then rejects must not consume a
+    max_compiled_buckets slot forever (no worker ever compiled it)."""
+    from dcr_tpu.serve.queue import BucketLimitError
+    from dcr_tpu.serve.supervisor import FleetSupervisor
+
+    cfg = ServeConfig(resolution=16, num_inference_steps=2, sampler="ddim",
+                      queue_depth=1, max_compiled_buckets=2,
+                      fleet=FleetConfig(workers=1, dir=str(tmp_path)))
+    sup = FleetSupervisor(cfg)        # not started: no subprocesses
+    sup._vae_scale = 8                # pretend a worker joined
+    sup.submit("a", seed=0)           # default bucket fills the queue
+    novel = _bucket(steps=7)
+    with pytest.raises(QueueFullError):
+        sup.submit("b", seed=1, bucket=novel)
+    assert novel not in sup._admitted_buckets     # slot rolled back
+    # the budget's second slot is still available to an admitted bucket
+    sup.queue.take_group(8)
+    sup.submit("c", seed=2, bucket=novel)
+    assert novel in sup._admitted_buckets
+    # and a third distinct bucket now correctly hits the limit
+    with pytest.raises(BucketLimitError):
+        sup.submit("d", seed=3, bucket=_bucket(steps=9))
+    sup.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# kill-a-worker acceptance e2e (slow; serve-chaos CI job)
+# ---------------------------------------------------------------------------
+
+def _run_fleet(tmp_path, ckpt, tag, *, faults=None, n_requests=8):
+    """One supervisor run: wait for both workers, POST n_requests, SIGTERM,
+    return ({(prompt, seed): (png_b64, w, h)}, journal replay counts)."""
+    import signal
+    import subprocess
+    import sys
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dcr_tpu.core.coordination import EXIT_PREEMPTED
+    from tests.test_serve import _get, _post_generate, _serve_env
+    from tests._multiproc import free_port
+
+    env, repo = _serve_env()
+    if faults:
+        # inherited by the spawned workers (the supervisor process itself
+        # never reaches a serve-batch fault hook); @rank= targets the worker
+        # index via the DCR_WORKER_INDEX the supervisor exports
+        env["DCR_FAULTS"] = faults
+    fleet_dir = tmp_path / f"fleet_{tag}"
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcr_tpu.cli.serve",
+         f"--model_path={ckpt}", f"--port={port}",
+         "--resolution=16", "--num_inference_steps=2", "--sampler=ddim",
+         "--max_batch=2", "--max_wait_ms=60", "--queue_depth=64",
+         "--request_timeout_s=300", "--seed=0",
+         "--fleet.workers=2", f"--fleet.dir={fleet_dir}",
+         "--fleet.heartbeat_s=0.5", "--fleet.lease_s=3",
+         "--fleet.dispatch_timeout_s=240", "--fleet.spawn_timeout_s=240",
+         "--fleet.max_attempts=6", "--fleet.respawn_max=2",
+         "--fleet.respawn_base_delay_s=2"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        deadline = time.monotonic() + 300
+        while True:
+            try:
+                _, health = _get(port, "/healthz", timeout=2)
+                _, status = _get(port, "/metrics", timeout=2)
+                if (health["status"] == "ok"
+                        and status["workers_alive"] == 2):
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None or time.monotonic() > deadline:
+                out = proc.stdout.read() if proc.stdout else ""
+                raise AssertionError(
+                    f"fleet did not come up (rc={proc.poll()}): {out[-4000:]}")
+            time.sleep(0.5)
+
+        prompts = ["a red square", "a blue circle"] * (n_requests // 2)
+        with ThreadPoolExecutor(max_workers=n_requests) as ex:
+            results = list(ex.map(
+                lambda a: (a, _post_generate(port, a[1], seed=a[0],
+                                             timeout=280)),
+                enumerate(prompts)))
+        responses = {}
+        for (seed, prompt), (code, doc) in results:
+            assert code == 200, (code, doc)
+            responses[(prompt, seed)] = (doc["image_png_b64"], doc["width"],
+                                         doc["height"])
+
+        _, status = _get(port, "/metrics", timeout=10)
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        out = proc.stdout.read() if proc.stdout else ""
+        assert rc == EXIT_PREEMPTED, (rc, out[-4000:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    from dcr_tpu.serve.fleet import RequestJournal
+
+    replay = RequestJournal.replay(fleet_dir / "journal.jsonl")
+    return responses, replay["counts"], status
+
+
+@pytest.mark.slow
+def test_fleet_kill_worker_zero_drops_bit_identical(tmp_path, cpu_devices):
+    """Acceptance: two workers, worker 0 SIGKILLed (injected worker_crash)
+    on every batch it ever touches — its in-flight requests are requeued
+    onto worker 1 and every accepted request completes, bit-identical to an
+    uninjected fleet, with the durable journal replaying to zero drops."""
+    from tests.test_serve import _export_tiny_ckpt
+
+    ckpt = _export_tiny_ckpt(tmp_path)
+
+    clean, clean_counts, _ = _run_fleet(tmp_path, ckpt, "clean")
+    assert clean_counts["dropped"] == 0 and clean_counts["failed"] == 0
+    assert clean_counts["accepted"] == 8 and clean_counts["acked"] == 8
+
+    chaos, chaos_counts, status = _run_fleet(
+        tmp_path, ckpt, "chaos", faults="worker_crash@batch=0&rank=0")
+    # zero dropped accepted requests, none failed, despite real SIGKILLs
+    assert chaos_counts["dropped"] == 0, chaos_counts
+    assert chaos_counts["failed"] == 0, chaos_counts
+    assert chaos_counts["accepted"] == 8 and chaos_counts["acked"] == 8
+    # the crash actually happened and the requeue path actually ran
+    assert chaos_counts["requeued_total"] >= 1, chaos_counts
+    assert status["fleet"].get("workers_lost", 0) >= 1, status["fleet"]
+    # bit-identical responses: an image is a pure function of (ckpt, prompt,
+    # seed, bucket) — which worker (or which incarnation) rendered it is
+    # invisible to the client
+    assert set(chaos) == set(clean)
+    for job in clean:
+        assert chaos[job] == clean[job], f"response diverged for {job}"

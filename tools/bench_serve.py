@@ -1,16 +1,30 @@
-"""Serving-throughput bench: dynamic batching vs one-request-at-a-time.
+"""Serving-throughput bench: dynamic batching vs one-request-at-a-time —
+plus a ``--chaos`` mode that proves availability under worker churn.
 
-Drives the real GenerationService in-process (no HTTP overhead in the
-numbers): a sequential baseline completes each request before submitting the
-next (max_batch=1 — the offline-loop serving model dcr-serve replaces), then
-the batched run submits the same workload concurrently against max_batch=N
-dynamic batching. Compilation is paid up front for both and excluded.
+Default mode drives the real GenerationService in-process (no HTTP overhead
+in the numbers): a sequential baseline completes each request before
+submitting the next (max_batch=1 — the offline-loop serving model dcr-serve
+replaces), then the batched run submits the same workload concurrently
+against max_batch=N dynamic batching. Compilation is paid up front for both
+and excluded. Writes BENCH_SERVE.json. Acceptance: batched > sequential.
 
-Writes BENCH_SERVE.json. Acceptance: batched throughput > sequential.
+``--chaos`` drives a real fleet (in-process FleetSupervisor, real worker
+SUBPROCESSES spawned through ``dcr_tpu.cli.serve``): the same fixed request
+load runs twice — once uninjected (baseline p99), once while a kill loop
+SIGKILLs an alive worker every K seconds (targets found via the fleet lease
+directory). Writes BENCH_SERVE_CHAOS.json with availability %, the
+dropped-accepted-request count replayed from the durable journal (MUST be
+0 — the process exits 1 otherwise), p99 with/without churn, and whether
+every churn-run response was bit-identical to the uninjected run (it must
+be: every image is a pure function of (ckpt, prompt, seed, bucket)).
 
-Usage: python tools/bench_serve.py
-Env knobs: BENCH_SERVE_REQUESTS (default 32), BENCH_SERVE_BATCH (default 8),
-BENCH_SERVE_STEPS (default 4), BENCH_SERVE_RES (default 16, tiny model).
+Usage: python tools/bench_serve.py [--chaos]
+Env knobs (default mode): BENCH_SERVE_REQUESTS (default 32),
+BENCH_SERVE_BATCH (default 8), BENCH_SERVE_STEPS (default 4),
+BENCH_SERVE_RES (default 16, tiny model).
+Env knobs (--chaos): BENCH_SERVE_CHAOS_REQUESTS (default 24),
+BENCH_SERVE_CHAOS_WORKERS (default 2), BENCH_SERVE_CHAOS_KILL_EVERY_S
+(default 10), BENCH_SERVE_STEPS / BENCH_SERVE_RES as above.
 """
 
 from __future__ import annotations
@@ -25,6 +39,7 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 OUT = Path(__file__).resolve().parent.parent / "BENCH_SERVE.json"
+OUT_CHAOS = Path(__file__).resolve().parent.parent / "BENCH_SERVE_CHAOS.json"
 
 
 def _build_stack():
@@ -136,5 +151,233 @@ def main() -> None:
     print(f"wrote {OUT}", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --chaos: availability under worker churn (real fleet, real SIGKILLs)
+# ---------------------------------------------------------------------------
+
+def _export_tiny_ckpt(dirpath: Path) -> Path:
+    """HF-layout tiny checkpoint the spawned worker subprocesses load —
+    the exact exporter the serve/fleet tests use (one source of truth for
+    the tiny model's layout; the repo root is already on sys.path)."""
+    from tests.test_serve import _export_tiny_ckpt as export
+
+    return export(dirpath)
+
+
+def _chaos_config(ckpt: Path, fleet_dir: Path, *, workers: int, steps: int,
+                  res: int):
+    from dcr_tpu.core.config import FleetConfig, ServeConfig
+
+    # churn-friendly knobs: quick death detection (tight lease), quick
+    # respawn (short backoff, high budget — the bench wants churn, not
+    # retirement), and enough dispatch attempts that a request surviving
+    # several kills still completes rather than 500s
+    return ServeConfig(
+        model_path=str(ckpt), resolution=res, num_inference_steps=steps,
+        sampler="ddim", max_batch=4, max_wait_ms=50.0, queue_depth=512,
+        request_timeout_s=600.0, seed=0,
+        fleet=FleetConfig(workers=workers, dir=str(fleet_dir),
+                          heartbeat_s=0.5, lease_s=3.0,
+                          dispatch_timeout_s=300.0, spawn_timeout_s=300.0,
+                          max_attempts=8, respawn_max=50,
+                          respawn_base_delay_s=0.5, respawn_max_delay_s=2.0))
+
+
+def _kill_loop(paths, workers: int, every_s: float, stop, kills: list) -> None:
+    """SIGKILL one alive worker every ``every_s`` seconds, targets found the
+    way any out-of-process chaos tool would: the lease directory. The victim
+    is the LONGEST-ALIVE worker (oldest ``started_at``): killing the first
+    alive index would keep executing a fresh respawn the moment it joined,
+    which models a crash-looping binary rather than churn — under that
+    regime nothing can complete anywhere and "availability" measures the
+    kill cadence, not the fleet."""
+    import signal
+
+    from dcr_tpu.serve.fleet import read_lease
+
+    # first blood comes fast: with a warm compile cache the whole workload
+    # can finish inside one full interval, and a churn run with zero kills
+    # proves nothing (chaos_main fails it)
+    delay = min(every_s, 1.5)
+    while not stop.wait(delay):
+        delay = every_s
+        alive = [l for l in (read_lease(paths, i) for i in range(workers))
+                 if l is not None and not l.expired()]
+        for lease in sorted(alive, key=lambda l: l.started_at):
+            try:
+                os.kill(lease.pid, signal.SIGKILL)
+            except OSError:
+                continue             # already gone — pick the next victim
+            kills.append({"t": time.time(), "worker": lease.index,
+                          "pid": lease.pid})
+            print(f"chaos: SIGKILL worker {lease.index} (pid {lease.pid})",
+                  flush=True)
+            break
+
+
+def _run_fleet_workload(cfg, jobs, *, kill_every_s=None) -> dict:
+    """One fleet run: submit every (prompt, seed) job concurrently, return
+    response docs keyed by job plus availability/latency/journal numbers."""
+    import threading
+
+    from dcr_tpu.serve.fleet import RequestJournal
+    from dcr_tpu.serve.supervisor import FleetSupervisor
+
+    sup = FleetSupervisor(cfg)
+    sup.start()
+    deadline = time.monotonic() + cfg.fleet.spawn_timeout_s
+    while sup.health() != "ok":
+        if time.monotonic() > deadline:
+            raise RuntimeError(
+                f"fleet did not come up: health={sup.health()!r} "
+                f"status={sup.status()!r}")
+        time.sleep(0.25)
+
+    stop_kills = threading.Event()
+    kills: list = []
+    killer = None
+    if kill_every_s:
+        killer = threading.Thread(
+            target=_kill_loop,
+            args=(sup.paths, cfg.fleet.workers, kill_every_s, stop_kills,
+                  kills),
+            daemon=True, name="chaos-killer")
+        killer.start()
+
+    t0 = time.perf_counter()
+    accepted, rejected, completed, failed = [], 0, {}, {}
+    for prompt, seed in jobs:
+        try:
+            accepted.append(((prompt, seed), sup.submit(prompt, seed=seed)))
+        except Exception as e:
+            rejected += 1
+            print(f"chaos: rejected ({prompt!r}, {seed}): {e!r}", flush=True)
+    for job, req in accepted:
+        try:
+            completed[job] = req.future.result(
+                timeout=cfg.request_timeout_s)
+        except Exception as e:
+            failed[f"{job[0]}#{job[1]}"] = repr(e)   # str key: JSON-safe
+    total_s = time.perf_counter() - t0
+
+    stop_kills.set()
+    if killer is not None:
+        killer.join(timeout=2 * (kill_every_s or 1.0))
+    sup.begin_drain()
+    sup.join_drained(cfg.request_timeout_s)
+    sup.shutdown()
+    replay = RequestJournal.replay(sup.paths.journal)
+
+    pct = sup.metrics.latency.percentiles((50, 99))
+    n_acc = len(accepted)
+    return {
+        "attempted": len(jobs),
+        "accepted": n_acc,
+        "rejected": rejected,
+        "completed": len(completed),
+        "failed": failed,
+        "availability_pct": round(100.0 * len(completed) / max(1, n_acc), 3),
+        "total_s": round(total_s, 3),
+        "requests_per_s": round(len(completed) / total_s, 3),
+        "latency_ms": {k: round(v * 1000.0, 3) for k, v in pct.items()},
+        "kills": kills,
+        "journal": replay["counts"],
+        "results": completed,
+    }
+
+
+def _response_key(doc: dict) -> tuple:
+    # the content that must be bit-identical across runs/workers; id, worker,
+    # cache_hit, and latency legitimately differ
+    return (doc.get("image_png_b64"), doc.get("width"), doc.get("height"))
+
+
+def chaos_main() -> None:
+    import tempfile
+
+    n_requests = int(os.environ.get("BENCH_SERVE_CHAOS_REQUESTS", "24"))
+    workers = int(os.environ.get("BENCH_SERVE_CHAOS_WORKERS", "2"))
+    # the interval must leave a worker's survivors room to actually finish
+    # batches between kills: on this CPU a respawned worker takes ~10s to
+    # rejoin and a batch runs for several seconds, so sub-5s cadences degrade
+    # into a crash loop where nothing completes anywhere
+    kill_every_s = float(os.environ.get("BENCH_SERVE_CHAOS_KILL_EVERY_S",
+                                        "10"))
+    steps = int(os.environ.get("BENCH_SERVE_STEPS", "4"))
+    res = int(os.environ.get("BENCH_SERVE_RES", "16"))
+
+    # share one persistent XLA compile cache across worker (re)spawns —
+    # respawned workers then reload in seconds instead of recompiling
+    repo = Path(__file__).resolve().parent.parent
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          str(repo / "tests" / ".jax_cache_cpu"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+    print(f"bench_serve --chaos: {n_requests} requests, {workers} workers, "
+          f"kill every {kill_every_s}s, steps={steps}, res={res}", flush=True)
+    jobs = [(p, i) for i, p in enumerate(_prompts(n_requests))]
+
+    with tempfile.TemporaryDirectory(prefix="dcr-chaos-") as td:
+        tmp = Path(td)
+        ckpt = _export_tiny_ckpt(tmp)
+        baseline = _run_fleet_workload(
+            _chaos_config(ckpt, tmp / "fleet_baseline", workers=workers,
+                          steps=steps, res=res), jobs)
+        print("baseline:", json.dumps({k: v for k, v in baseline.items()
+                                       if k != "results"}), flush=True)
+        churn = _run_fleet_workload(
+            _chaos_config(ckpt, tmp / "fleet_churn", workers=workers,
+                          steps=steps, res=res), jobs,
+            kill_every_s=kill_every_s)
+        print("churn:", json.dumps({k: v for k, v in churn.items()
+                                    if k != "results"}), flush=True)
+
+    mismatched = [job for job in baseline["results"]
+                  if job in churn["results"]
+                  and _response_key(baseline["results"][job])
+                  != _response_key(churn["results"][job])]
+    result = {
+        "requests": n_requests, "workers": workers,
+        "kill_every_s": kill_every_s, "steps": steps, "resolution": res,
+        "sampler": "ddim", "model": "tiny",
+        "baseline": {k: v for k, v in baseline.items() if k != "results"},
+        "churn": {k: v for k, v in churn.items() if k != "results"},
+        "kills": len(churn["kills"]),
+        "dropped_accepted_requests": churn["journal"]["dropped"],
+        "requeued": churn["journal"]["requeued_total"],
+        "availability_pct": churn["availability_pct"],
+        "p99_ms_baseline": baseline["latency_ms"].get("p99"),
+        "p99_ms_churn": churn["latency_ms"].get("p99"),
+        "bit_identical_responses": not mismatched,
+        "mismatched_jobs": [list(j) for j in mismatched],
+    }
+    OUT_CHAOS.write_text(json.dumps(result, indent=2) + "\n")
+    print(f"wrote {OUT_CHAOS}", flush=True)
+
+    problems = []
+    if churn["journal"]["dropped"] != 0:
+        problems.append(
+            f"dropped accepted requests: {churn['journal']['dropped']}")
+    if churn["availability_pct"] < 100.0:
+        problems.append(f"availability {churn['availability_pct']}% "
+                        f"(failed: {churn['failed']})")
+    if mismatched:
+        problems.append(f"{len(mismatched)} response(s) not bit-identical "
+                        f"to the uninjected run")
+    if not churn["kills"]:
+        problems.append("kill loop never fired — the churn run proved "
+                        "nothing (workload too short for the cadence?)")
+    if problems:
+        print("CHAOS FAIL: " + "; ".join(problems), flush=True)
+        raise SystemExit(1)
+    print(f"CHAOS OK: {len(churn['kills'])} kill(s), "
+          f"{churn['journal']['requeued_total']} requeue(s), 0 drops, "
+          f"bit-identical responses", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if "--chaos" in sys.argv[1:]:
+        chaos_main()
+    else:
+        main()
