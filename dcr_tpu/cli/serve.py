@@ -74,8 +74,11 @@ def _run_supervisor(cfg: ServeConfig) -> None:
         # somewhere concrete before the config is serialized for workers
         cfg.fleet.dir = (str(Path(cfg.logdir) / "fleet") if cfg.logdir
                          else tempfile.mkdtemp(prefix="dcr-fleet-"))
-    if cfg.logdir:
-        tracing.configure(cfg.logdir)
+    # trace sink falls back to the fleet dir: workers mirror this (their
+    # files land under <fleet.dir>/worker_<i>/), so a fleet run is ALWAYS
+    # mergeable by `tools/trace_report <fleet.dir>` — one connected span
+    # tree per request across supervisor + workers — without any --logdir
+    tracing.configure(cfg.logdir or cfg.fleet.dir)
 
     drained = threading.Event()
     # fleet-fatal (every slot retired) unblocks the same wait as SIGTERM:
@@ -147,10 +150,13 @@ def _run_worker(cfg: ServeConfig) -> None:
         # index (the supervisor exports this too; setdefault keeps a
         # hand-launched worker targetable)
         os.environ.setdefault("DCR_WORKER_INDEX", str(index))
-        if logdir:
-            # per-worker telemetry sink — N workers sharing the supervisor's
-            # logdir would interleave writes into one trace.jsonl
-            logdir = str(Path(logdir) / f"worker_{index}")
+        # per-worker telemetry sink — N workers sharing the supervisor's
+        # logdir would interleave writes into one trace.jsonl. Without
+        # --logdir a fleet worker falls back to the fleet dir, mirroring
+        # the supervisor, so `tools/trace_report <fleet.dir>` always sees
+        # every process's file
+        base = logdir or cfg.fleet.dir
+        logdir = str(Path(base) / f"worker_{index}") if base else None
 
     dist.initialize()
     if logdir:

@@ -331,6 +331,13 @@ class FleetConfig:
     # 0 disables shedding.
     slo_queue_wait_p99_s: float = 0.0
     shed_retry_after_s: float = 5.0  # Retry-After hint on shed responses
+    # fleet metrics aggregation (dcr-scope): the supervisor scrapes each
+    # live worker's /metrics?format=prometheus on this cadence and serves
+    # the merged, worker="N"-labeled exposition from the front end. The
+    # per-target socket timeout bounds a dead worker's cost per cycle —
+    # the merged endpoint itself never blocks on a worker.
+    scrape_period_s: float = 2.0
+    scrape_timeout_s: float = 2.0
 
 
 @dataclass
@@ -413,6 +420,10 @@ def validate_serve_config(cfg: ServeConfig) -> None:
             raise ValueError("fleet.max_attempts must be >= 1")
         if f.respawn_max < 0:
             raise ValueError("fleet.respawn_max must be >= 0")
+        if f.scrape_period_s <= 0 or f.scrape_timeout_s <= 0:
+            raise ValueError("fleet.scrape_period_s and fleet.scrape_timeout_s"
+                             " must be > 0 (an unbounded scrape turns a dead "
+                             "worker into a hung /metrics)")
 
 
 @dataclass

@@ -12,7 +12,14 @@ numbers from:
   wall-clock timestamps, rank/thread tags. Spans append to a per-process
   ``trace.jsonl`` under the run directory once :func:`configure` has run;
   ``tools/trace_report.py`` turns the files into a stage-time breakdown and
-  a Chrome-trace/Perfetto export.
+  a Chrome-trace/Perfetto export. Spans may additionally carry a
+  **distributed trace id** (:func:`new_trace_id`, inherited via contextvars,
+  shipped across processes with :func:`wire_context`) — the fleet
+  supervisor stamps one per request so supervisor and worker trace files
+  merge into one span tree per request. The file is size-capped:
+  ``DCR_TRACE_MAX_MB`` rotates it into ``trace.jsonl.1..N``
+  (``DCR_TRACE_KEEP``, default 3) so a weeks-long serve worker cannot fill
+  the disk.
 - **Telemetry registry** — one process-wide home for counters, gauges and
   histograms. ``resilience.bump_counter`` feeds ``faults/*`` counters here,
   ``MetricWriter.scalars`` mirrors every scalar into a gauge, and named
@@ -78,16 +85,36 @@ class _TraceState:
         self.lock = threading.Lock()
         self.dir: Optional[Path] = None
         self.file = None
+        self.path: Optional[Path] = None
         self.rank: Optional[int] = None
         self.ring: deque = deque(
             maxlen=int(os.environ.get("DCR_FLIGHTREC_SPANS", "256") or 256))
         self.ids = itertools.count(1)
         self.dumped: Optional[Path] = None
+        # size-capped rotation: a long-lived serve worker must not grow
+        # trace.jsonl without bound. 0 = unlimited (training runs are short
+        # relative to serve's weeks).
+        self.max_bytes = 0
+        self.keep = 3
+        self.bytes_written = 0
 
 
 _state = _TraceState()
 _current_span: contextvars.ContextVar[Optional[int]] = contextvars.ContextVar(
     "dcr_current_span", default=None)
+# the distributed trace id (a 16-hex-char token) the current span belongs to.
+# Propagated like the parent id: automatic within a process via contextvars,
+# explicit across processes via the wire context the fleet supervisor injects
+# into every dispatched batch (serve/supervisor.py) — which is what stitches
+# supervisor and worker trace files into one span tree per request.
+_current_trace: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "dcr_current_trace", default=None)
+
+
+def new_trace_id() -> str:
+    """Fresh 64-bit distributed-trace id. os.urandom, not the random module:
+    trace ids must never perturb (or depend on) any seeded RNG stream."""
+    return os.urandom(8).hex()
 
 
 def configure(directory: str | Path, *, rank: Optional[int] = None) -> Optional[Path]:
@@ -113,10 +140,16 @@ def configure(directory: str | Path, *, rank: Optional[int] = None) -> Optional[
             except OSError as e:
                 log.warning("[trace] trace_file_close_failed %r", e)
             _state.file = None
+            _state.path = None
         if os.environ.get("DCR_TRACE", "1") == "0":
             return None
         directory.mkdir(parents=True, exist_ok=True)
         path = directory / name
+        _state.max_bytes = int(
+            float(os.environ.get("DCR_TRACE_MAX_MB", "0") or 0) * 1e6)
+        _state.keep = max(1, int(os.environ.get("DCR_TRACE_KEEP", "3") or 3))
+        _state.bytes_written = path.stat().st_size if path.exists() else 0
+        _state.path = path
         _state.file = path.open("a", buffering=1)  # line-buffered: crash-safe
     return path
 
@@ -130,13 +163,39 @@ def _rank() -> int:
     return _detect_rank() if r is None else r
 
 
+def _rotate_locked() -> None:
+    """Shift ``trace.jsonl`` -> ``.1`` -> ... -> ``.keep`` (oldest dropped)
+    and reopen a fresh file. Caller holds ``_state.lock``. Rotation failures
+    are loud but non-fatal: telemetry must never kill the workload."""
+    path = _state.path
+    try:
+        _state.file.close()
+    except OSError as e:
+        log.warning("[trace] trace_file_close_failed during rotate %r", e)
+    _state.file = None
+    try:
+        for i in range(_state.keep - 1, 0, -1):
+            seg = path.with_name(f"{path.name}.{i}")
+            if seg.exists():
+                os.replace(seg, path.with_name(f"{path.name}.{i + 1}"))
+        os.replace(path, path.with_name(f"{path.name}.1"))
+        _state.file = path.open("a", buffering=1)
+        _state.bytes_written = 0
+    except OSError as e:
+        log.warning("[trace] trace_rotate_failed (ring-only from here): %r", e)
+
+
 def _emit(rec: dict) -> None:
     with _state.lock:
         _state.ring.append(rec)
         f = _state.file
         if f is not None:
             try:
-                f.write(json.dumps(rec, default=str) + "\n")
+                line = json.dumps(rec, default=str) + "\n"
+                f.write(line)
+                _state.bytes_written += len(line)
+                if _state.max_bytes and _state.bytes_written > _state.max_bytes:
+                    _rotate_locked()
             except (OSError, ValueError) as e:  # full disk / closed file:
                 # telemetry must never kill the workload — drop to ring-only
                 _state.file = None
@@ -150,12 +209,15 @@ class SpanHandle:
     begun on the HTTP handler thread and ended by the future's callback).
     Prefer :func:`span` whenever a ``with`` block fits."""
 
-    __slots__ = ("name", "id", "parent", "attrs", "_t0_wall", "_t0", "_done")
+    __slots__ = ("name", "id", "parent", "trace", "attrs", "_t0_wall", "_t0",
+                 "_done")
 
-    def __init__(self, name: str, parent: Optional[int], attrs: dict):
+    def __init__(self, name: str, parent: Optional[int],
+                 trace: Optional[str], attrs: dict):
         self.name = name
         self.id = next(_state.ids)
         self.parent = parent
+        self.trace = trace
         self.attrs = attrs
         self._t0_wall = time.time()
         self._t0 = time.monotonic()
@@ -166,66 +228,96 @@ class SpanHandle:
             return
         self._done = True
         dur = time.monotonic() - self._t0
-        _emit({"ph": _PH_SPAN, "name": self.name, "id": self.id,
+        rec = {"ph": _PH_SPAN, "name": self.name, "id": self.id,
                "parent": self.parent, "ts": round(self._t0_wall * 1e6),
                "dur": round(dur * 1e6), "pid": _rank(),
                "tid": threading.get_ident(),
                "tname": threading.current_thread().name,
-               "args": {**self.attrs, **extra}})
+               "args": {**self.attrs, **extra}}
+        if self.trace is not None:
+            rec["trace"] = self.trace
+        _emit(rec)
 
 
 def begin_span(name: str, *, parent: Optional[int] = None,
-               **attrs: Any) -> SpanHandle:
-    """Open a :class:`SpanHandle`; the caller owns ``.end()``."""
+               trace: Optional[str] = None, **attrs: Any) -> SpanHandle:
+    """Open a :class:`SpanHandle`; the caller owns ``.end()``. ``trace``
+    defaults to the enclosing span's distributed-trace id (contextvars)."""
     return SpanHandle(name, parent if parent is not None else _current_span.get(),
+                      trace if trace is not None else _current_trace.get(),
                       attrs)
 
 
 @contextmanager
 def span(name: str, *, parent: Optional[int] = None,
-         **attrs: Any) -> Iterator[SpanHandle]:
-    """Record the block as one span. Parent defaults to the enclosing span in
-    this context (contextvars), so nesting is automatic; an exception in the
-    block is recorded as an ``error`` attr and re-raised unchanged."""
-    h = begin_span(name, parent=parent, **attrs)
+         trace: Optional[str] = None, **attrs: Any) -> Iterator[SpanHandle]:
+    """Record the block as one span. Parent (and distributed-trace id)
+    default to the enclosing span in this context (contextvars), so nesting
+    is automatic; an exception in the block is recorded as an ``error`` attr
+    and re-raised unchanged."""
+    h = begin_span(name, parent=parent, trace=trace, **attrs)
     token = _current_span.set(h.id)
+    trace_token = _current_trace.set(h.trace)
     try:
         yield h
     except BaseException as e:
         h.end(error=repr(e))
         raise
     finally:
+        _current_trace.reset(trace_token)
         _current_span.reset(token)
         h.end()
 
 
 def event(name: str, *, parent: Optional[int] = None,
+          trace: Optional[str] = None,
           attrs: Optional[Mapping[str, Any]] = None, **kw: Any) -> None:
     """Instant (zero-duration) trace event — compiles, faults, decisions.
 
     Attributes ride as keywords; pass ``attrs=`` for dicts whose keys could
     collide with ``name``/``parent`` (e.g. resilience.log_event fields)."""
-    _emit({"ph": _PH_EVENT, "name": name, "id": next(_state.ids),
+    rec = {"ph": _PH_EVENT, "name": name, "id": next(_state.ids),
            "parent": parent if parent is not None else _current_span.get(),
            "ts": round(time.time() * 1e6), "pid": _rank(),
            "tid": threading.get_ident(),
            "tname": threading.current_thread().name,
-           "args": {**(attrs or {}), **kw}})
+           "args": {**(attrs or {}), **kw}}
+    trace = trace if trace is not None else _current_trace.get()
+    if trace is not None:
+        rec["trace"] = trace
+    _emit(rec)
 
 
 def complete_span(name: str, *, start_wall: float, dur_s: float,
-                  parent: Optional[int] = None, **attrs: Any) -> None:
+                  parent: Optional[int] = None, trace: Optional[str] = None,
+                  **attrs: Any) -> None:
     """Record a span measured elsewhere (e.g. queue wait reconstructed from a
     request's admission stamp when the batch finally forms)."""
-    _emit({"ph": _PH_SPAN, "name": name, "id": next(_state.ids),
+    rec = {"ph": _PH_SPAN, "name": name, "id": next(_state.ids),
            "parent": parent, "ts": round(start_wall * 1e6),
            "dur": round(max(dur_s, 0.0) * 1e6), "pid": _rank(),
            "tid": threading.get_ident(),
-           "tname": threading.current_thread().name, "args": attrs})
+           "tname": threading.current_thread().name, "args": attrs}
+    if trace is not None:
+        rec["trace"] = trace
+    _emit(rec)
 
 
 def current_span_id() -> Optional[int]:
     return _current_span.get()
+
+
+def current_trace_id() -> Optional[str]:
+    return _current_trace.get()
+
+
+def wire_context(span: SpanHandle, attempt: int = 1) -> dict:
+    """The cross-process trace context a dispatcher ships with work: enough
+    for the receiving process to parent its own root span under ``span``
+    even though span ids are process-local. ``attempt`` tags requeued
+    re-executions so they merge as sibling children of the same root."""
+    return {"trace_id": span.trace, "parent_span": span.id,
+            "attempt": int(attempt)}
 
 
 # ---------------------------------------------------------------------------
@@ -306,6 +398,37 @@ class Histogram:
                 **self.percentiles((50, 90, 99))}
 
 
+def sanitize_metric_name(name: str) -> str:
+    """Internal slash-style metric name (``faults/x``, ``stage/eval``) ->
+    valid Prometheus identifier ``[a-zA-Z_:][a-zA-Z0-9_:]*``. The ``dcr_``
+    prefix both namespaces the export and guarantees a legal first char."""
+    return "dcr_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+
+
+def sanitize_label_name(name: str) -> str:
+    """Label-name form of :func:`sanitize_metric_name` (labels may not
+    contain colons and may not start with a digit)."""
+    s = re.sub(r"[^a-zA-Z0-9_]", "_", name)
+    return s if s and not s[0].isdigit() else "_" + s
+
+
+def prometheus_value(v: float) -> str:
+    """Render a sample value; Python's ``inf``/``nan`` spellings are not
+    valid exposition-format tokens."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    return repr(f) if isinstance(v, float) else str(v)
+
+
+def prometheus_escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 class TelemetryRegistry:
     """The process-wide metric home. Every sink registers here so one
     snapshot answers for the whole process, whichever subsystem is asked
@@ -367,29 +490,45 @@ class TelemetryRegistry:
         """The registry in Prometheus text exposition format. Counters/gauges
         map 1:1; histograms render as summaries (quantile labels + _sum/_count).
         ``dcr_faults_total`` is always present (0 when clean) so a scrape can
-        alert on its rate before the first fault ever fires."""
+        alert on its rate before the first fault ever fires.
+
+        Exposition hygiene: every metric gets a ``# HELP`` line naming the
+        internal (slash-style) metric it was sanitized from, non-finite
+        values render as Prometheus ``+Inf``/``-Inf``/``NaN`` tokens, and two
+        internal names that sanitize to the same identifier share one
+        HELP/TYPE header instead of emitting an invalid duplicate."""
         snap = self.snapshot()
         lines: list[str] = []
+        headered: set[str] = set()
 
-        def san(name: str) -> str:
-            return "dcr_" + re.sub(r"[^a-zA-Z0-9_]", "_", name)
+        def header(m: str, orig: str, kind: str) -> None:
+            if m in headered:
+                return
+            headered.add(m)
+            lines.append(f"# HELP {m} dcr_tpu internal metric "
+                         f"{prometheus_escape_help(orig)!r}")
+            lines.append(f"# TYPE {m} {kind}")
 
         for name, value in sorted(snap["counters"].items()):
-            m = san(name)
-            lines += [f"# TYPE {m} counter", f"{m} {value}"]
+            m = sanitize_metric_name(name)
+            header(m, name, "counter")
+            lines.append(f"{m} {prometheus_value(value)}")
+        header("dcr_faults_total", "sum of faults/* counters", "counter")
         faults_total = sum(v for k, v in snap["counters"].items()
                            if k.startswith("faults/"))
-        lines += ["# TYPE dcr_faults_total counter",
-                  f"dcr_faults_total {faults_total}"]
+        lines.append(f"dcr_faults_total {prometheus_value(faults_total)}")
         for name, value in sorted(snap["gauges"].items()):
-            m = san(name)
-            lines += [f"# TYPE {m} gauge", f"{m} {value}"]
+            m = sanitize_metric_name(name)
+            header(m, name, "gauge")
+            lines.append(f"{m} {prometheus_value(value)}")
         for name, h in sorted(snap["histograms"].items()):
-            m = san(name)
-            lines.append(f"# TYPE {m} summary")
+            m = sanitize_metric_name(name)
+            header(m, name, "summary")
             for q in (50, 90, 99):
-                lines.append(f'{m}{{quantile="0.{q}"}} {h[f"p{q}"]}')
-            lines += [f"{m}_sum {h['sum']}", f"{m}_count {h['count']}"]
+                lines.append(
+                    f'{m}{{quantile="0.{q}"}} {prometheus_value(h[f"p{q}"])}')
+            lines.append(f"{m}_sum {prometheus_value(h['sum'])}")
+            lines.append(f"{m}_count {prometheus_value(h['count'])}")
         return "\n".join(lines) + "\n"
 
 
@@ -452,7 +591,13 @@ def dump_flight_recorder(reason: str, *,
     if not d:
         return None
     rank = _rank()
-    path = Path(d) / f"flightrec_{rank}.json"
+    # fleet workers are all rank 0 and may share a dump directory (the fleet
+    # dir when no --logdir is set): the worker index must be in the filename
+    # or one crashing worker clobbers another's post-mortem
+    widx = os.environ.get("DCR_WORKER_INDEX")
+    name = (f"flightrec_{rank}.json" if widx is None
+            else f"flightrec_w{widx}_{rank}.json")
+    path = Path(d) / name
     doc = {
         "version": TRACE_VERSION,
         "reason": reason,
@@ -515,8 +660,11 @@ def reset_for_tests() -> None:
             except OSError:
                 log.warning("[trace] trace_file_close_failed during reset")
         _state.file = None
+        _state.path = None
         _state.dir = None
         _state.rank = None
         _state.dumped = None
+        _state.max_bytes = 0
+        _state.bytes_written = 0
         _state.ring.clear()
     _registry.reset()

@@ -32,6 +32,7 @@ from dcr_tpu.core.config import TrainConfig, run_name, save_config, to_dict, val
 from dcr_tpu.core.metrics import MetricWriter
 from dcr_tpu.core import rng as rngmod
 from dcr_tpu.utils import faults
+from dcr_tpu.utils import profiling
 from dcr_tpu.data.dataset import ObjectAttributeDataset
 from dcr_tpu.data.loader import DataLoader
 from dcr_tpu.data.tokenizer import TokenizerBase, load_tokenizer
@@ -496,6 +497,12 @@ class Trainer:
         global_bs = cfg.train_batch_size * (
             jax.local_device_count() if self.replica_mode else jax.device_count())
         flops_per_step: float | None = None  # filled after first compiled step
+        # on-demand device profiling (dcr-scope): DCR_PROFILE_AT_STEP=K arms
+        # a jax.profiler capture around micro-steps [K, K+DCR_PROFILE_STEPS)
+        # via the same utils/profiling armer serve's POST /debug/profile
+        # uses; the artifact lands under <output_dir>/profile
+        profile_at = int(os.environ.get("DCR_PROFILE_AT_STEP", "-1") or -1)
+        profile_steps = int(os.environ.get("DCR_PROFILE_STEPS", "1") or 1)
         log.info("training: %d optimizer steps (micro-batch accum %d, "
                  "%d micro/epoch), global batch %d",
                  max_sync, accum, steps_per_epoch, global_bs)
@@ -511,10 +518,19 @@ class Trainer:
                     batch = next(epoch_iter, None)
                 if batch is None:
                     break
-                with tracing.span("train/step", step=step):
-                    sharded = pmesh.shard_batch(self.mesh, dict(batch))
-                    self.state, metrics = self.step_fn(self.state, sharded,
-                                                       self.train_key)
+                if step == profile_at:
+                    try:
+                        profiling.arm(str(self.out_dir / "profile"),
+                                      profile_steps)
+                        R.log_trace("profile_armed", at_step=step,
+                                    steps=profile_steps)
+                    except (RuntimeError, ValueError) as e:
+                        R.log_event("profile_arm_failed", error=repr(e))
+                with profiling.capture():
+                    with tracing.span("train/step", step=step):
+                        sharded = pmesh.shard_batch(self.mesh, dict(batch))
+                        self.state, metrics = self.step_fn(self.state, sharded,
+                                                           self.train_key)
                 step += 1
                 imgs_last += global_bs
                 self.watchdog.beat(step)

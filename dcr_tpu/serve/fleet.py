@@ -224,6 +224,9 @@ class JournalEntry:
     charged: int = 0          # attempts counted against max_attempts: a
                               # worker-state rejection (drain/overload) is
                               # refunded — the request never executed there
+    trace_id: str = ""        # distributed trace id: constant across
+                              # requeues, so the journal links every dispatch
+                              # attempt to one cross-process span tree
 
 
 # How many terminal (acked/failed) entries the journal keeps addressable for
@@ -306,11 +309,12 @@ class RequestJournal:
             if req.id in self._entries or req.id in self._terminal:
                 raise ValueError(f"request {req.id} already journaled")
             e = JournalEntry(id=req.id, prompt=req.prompt, seed=req.seed,
-                             bucket=tuple(req.bucket))
+                             bucket=tuple(req.bucket),
+                             trace_id=getattr(req, "trace_id", None) or "")
             self._entries[req.id] = e
             self._accepted_total += 1
             self._append("add", id=req.id, prompt=req.prompt, seed=req.seed,
-                         bucket=list(req.bucket))
+                         bucket=list(req.bucket), trace=e.trace_id)
             return e
 
     def reject(self, req_id: int, reason: str) -> None:

@@ -98,6 +98,10 @@ class Request:
     # device step, respond — parent on its id, giving one span tree per
     # request id across the handler and worker threads
     span: Any = None
+    # distributed trace id (tracing.new_trace_id / the supervisor's wire
+    # context): constant across requeues and across processes, so a fleet
+    # request's supervisor-side and worker-side spans merge into one tree
+    trace_id: Optional[str] = None
 
 
 class RequestQueue:

@@ -152,6 +152,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         elif url.path == "/metrics":
             fmt = parse_qs(url.query).get("format", ["json"])[0]
             if fmt == "prometheus":
+                merged = getattr(self.service, "prometheus_merged", None)
+                if callable(merged):
+                    # fleet supervisor: its own registry plus every worker's
+                    # scraped registry, worker="N"-labeled — built from the
+                    # bounded-timeout scrape cache, so a dead worker can
+                    # never hang this handler
+                    self._reply_text(200, merged())
+                    return
                 # fold the live service document into registry gauges, then
                 # render the whole registry (incl. faults/* counters and the
                 # request-latency summary) in Prometheus text format
@@ -161,6 +169,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                 self._reply_text(200, tracing.registry().prometheus_text())
             else:
                 self._reply(200, self.service.status())
+        elif url.path == "/debug/profile":
+            status_fn = getattr(self.service, "profile_status", None)
+            if not callable(status_fn):
+                self._reply(404, {"error": "profiling not supported"})
+                return
+            try:
+                self._reply(200, status_fn())
+            except Exception as e:
+                self._reply(500, {"error": f"profile status failed: {e!r}"})
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
 
@@ -195,8 +212,38 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._post_generate()
         elif self.path == "/generate_batch":
             self._post_generate_batch()
+        elif self.path == "/debug/profile":
+            self._post_profile()
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+
+    def _post_profile(self) -> None:
+        """Arm an on-demand jax.profiler capture: on a worker, around its own
+        next K device steps; on a fleet supervisor, routed to a chosen (or
+        the first alive) worker. Replies with the armed status including the
+        artifact directory; poll GET /debug/profile until it reports the
+        artifact written."""
+        profile_fn = getattr(self.service, "profile", None)
+        if not callable(profile_fn):
+            self._reply(404, {"error": "profiling not supported"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        try:
+            self._reply(200, profile_fn(body))
+        except AdmissionError as e:
+            self._reply(*admission_response(e))
+        except (ValueError, RuntimeError) as e:
+            # typed arming failures: already armed, unknown worker, no logdir
+            self._reply(409, {"error": str(e)})
+        except Exception as e:
+            self._reply(500, {"error": f"profile arm failed: {e!r}"})
 
     def _post_generate(self) -> None:
         try:
@@ -222,7 +269,8 @@ class ServeHandler(BaseHTTPRequestHandler):
         # respond leg of the request's span tree: PNG encode + socket write
         # happen on this handler thread, off the device worker's critical path
         with tracing.span("serve/respond", request_id=req.id,
-                          parent=req.span.id if req.span is not None else None):
+                          parent=req.span.id if req.span is not None else None,
+                          trace=req.trace_id):
             self._reply(200, self._render(req, result))
 
     def _post_generate_batch(self) -> None:
@@ -243,9 +291,14 @@ class ServeHandler(BaseHTTPRequestHandler):
         reqs: list = []
         for item in items:
             try:
+                # the dispatcher's distributed trace context rides next to
+                # the generation fields; it is not a bucket override
+                item = dict(item) if isinstance(item, dict) else item
+                tctx = item.pop("trace", None) if isinstance(item, dict) else None
                 prompt, seed, bucket = self._parse_one(item)
-                reqs.append(self.service.submit(prompt, seed=seed,
-                                                bucket=bucket))
+                reqs.append(self.service.submit(
+                    prompt, seed=seed, bucket=bucket,
+                    trace_ctx=tctx if isinstance(tctx, dict) else None))
             except (KeyError, TypeError, ValueError, AdmissionError) as e:
                 reqs.append({"error": f"{type(e).__name__}: {e}"})
         results: list[dict] = []
@@ -260,7 +313,7 @@ class ServeHandler(BaseHTTPRequestHandler):
                 continue
             with tracing.span("serve/respond", request_id=req.id,
                               parent=req.span.id if req.span is not None
-                              else None):
+                              else None, trace=req.trace_id):
                 results.append(self._render(req, image))
         self._reply(200, {"results": results})
 

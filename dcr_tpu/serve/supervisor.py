@@ -61,6 +61,8 @@ from dcr_tpu.core.metrics import LatencyTracker
 from dcr_tpu.serve.batcher import Batcher
 from dcr_tpu.serve.fleet import (FleetPaths, RequestJournal, WorkerLease,
                                  clear_lease, fleet_paths, read_lease)
+from dcr_tpu.serve.scrape import (ScrapeCache, http_get_text, inject_labels,
+                                  merge_expositions)
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket, NoWorkersError,
                                  Request, RequestQueue, SloShedError)
@@ -205,27 +207,38 @@ class DispatchChannel:
         t0 = time.monotonic()
         now_wall = time.time()
         send: list[Request] = []
+        attempts: dict[int, int] = {}
         for req in batch:
-            if sup.journal.dispatch(req.id, self.index) is None:
+            attempt = sup.journal.dispatch(req.id, self.index)
+            if attempt is None:
                 continue    # completed via a duplicate path while queued
+            attempts[req.id] = attempt
             waited = t0 - req.enqueued_at
             sup.metrics.queue_wait.observe(waited)
             tracing.complete_span(
                 "serve/queue_wait", start_wall=now_wall - waited,
                 dur_s=waited,
                 parent=req.span.id if req.span is not None else None,
-                request_id=req.id)
+                trace=req.trace_id, request_id=req.id)
             send.append(req)
         if not send:
             return True
         b = send[0].bucket
+        # each wire item carries its distributed trace context: the worker
+        # parents its serve/request span on the supervisor's root, so one
+        # request = one span tree across both processes — and a requeued
+        # re-execution ships the same trace id with attempt+1, merging as a
+        # sibling child of the same root
         payload = {"requests": [
             {"prompt": r.prompt, "seed": r.seed, "resolution": b.resolution,
              "steps": b.steps, "guidance": b.guidance, "sampler": b.sampler,
-             "rand_noise_lam": b.rand_noise_lam} for r in send]}
+             "rand_noise_lam": b.rand_noise_lam,
+             "trace": (tracing.wire_context(r.span, attempts[r.id])
+                       if r.span is not None else None)} for r in send]}
         ids = [r.id for r in send]
         with tracing.span("fleet/dispatch", worker=self.index,
-                          batch=len(send), request_ids=ids):
+                          batch=len(send), request_ids=ids,
+                          trace_ids=[r.trace_id for r in send]):
             try:
                 status, doc = _post_json(
                     cfg.host, self.port, "/generate_batch", payload,
@@ -312,6 +325,9 @@ class FleetSupervisor:
         self._poll_s = max(0.05, min(0.25, cfg.fleet.heartbeat_s / 2))
         self._healthy_reset_s = max(10.0, 5 * cfg.fleet.heartbeat_s)
         self._monitor: Optional[threading.Thread] = None
+        self._scrape = ScrapeCache(cfg.host, cfg.fleet.scrape_timeout_s)
+        self._scraper: Optional[threading.Thread] = None
+        self._last_profile_worker: Optional[int] = None
 
     def counter(self, name: str):
         return tracing.registry().counter(f"fleet/{name}")
@@ -328,6 +344,9 @@ class FleetSupervisor:
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True, name="fleet-monitor")
         self._monitor.start()
+        self._scraper = threading.Thread(target=self._scrape_loop,
+                                         daemon=True, name="fleet-scraper")
+        self._scraper.start()
 
     def _spawn(self, slot: _WorkerSlot) -> None:
         f = self.cfg.fleet
@@ -339,8 +358,13 @@ class FleetSupervisor:
                 f"--fleet.worker_index={slot.index}",
                 "--port=0"]
         env = dict(os.environ)
-        # the `rank` fault coordinate of serve-side DCR_FAULTS kinds
+        # the `rank` fault coordinate of serve-side DCR_FAULTS kinds (also
+        # keys the worker's flightrec_w<i>_<rank>.json dump name)
         env["DCR_WORKER_INDEX"] = str(slot.index)
+        # fallback post-mortem destination for workers running without a
+        # --logdir: all workers share the fleet dir, so the worker-indexed
+        # dump name above is what keeps one crash from clobbering another's
+        env.setdefault("DCR_FLIGHTREC_DIR", str(self.paths.root))
         try:
             with open(self.paths.worker_log(slot.index), "ab") as logf:
                 slot.proc = subprocess.Popen(argv, stdout=logf,
@@ -384,6 +408,11 @@ class FleetSupervisor:
         slot.respawn_at = time.time() + delay
         retire = slot.consecutive_failures > f.respawn_max
         slot.state = RETIRED if retire else BACKOFF
+        if retire:
+            # a permanently-down slot must not keep serving its last scraped
+            # numbers forever from the merged /metrics; the up/staleness
+            # gauges still report the slot itself as down
+            self._scrape.forget(slot.index)
         return retire
 
     def _worker_failed(self, slot: _WorkerSlot, reason: str) -> None:
@@ -490,10 +519,141 @@ class FleetSupervisor:
                             self.counter("respawns").inc()
                             self._spawn(slot)
             tracing.registry().gauge("fleet/workers_alive").set(float(alive))
+            self._update_slo_gauges(alive)
             if (alive == 0
                     and all(s.state == RETIRED for s in self._slots)
                     and not self._fatal.is_set()):
                 self._fail_fleet()
+
+    def _update_slo_gauges(self, alive: int) -> None:
+        """Fleet SLO series as first-class exported gauges (scraped via
+        /metrics?format=prometheus) instead of log lines: queue-wait p99 vs
+        its target, shed rate, requeue rate, availability."""
+        reg = tracing.registry()
+        f = self.cfg.fleet
+        reg.gauge("fleet/availability").set(alive / max(1, len(self._slots)))
+        reg.gauge("fleet/queue_wait_p99_s").set(
+            self.metrics.queue_wait.percentiles((99,))["p99"])
+        reg.gauge("fleet/slo_queue_wait_p99_s").set(f.slo_queue_wait_p99_s)
+        counts = reg.counters("fleet/")
+        accepted = counts.get("fleet/accepted", 0)
+        shed = counts.get("fleet/shed", 0)
+        reg.gauge("fleet/shed_rate").set(shed / max(1, accepted + shed))
+        reg.gauge("fleet/requeue_rate").set(
+            counts.get("fleet/requeued", 0) / max(1, accepted))
+
+    # -- fleet metrics aggregation -------------------------------------------
+
+    def _scrape_loop(self) -> None:
+        """Pull each live worker's full telemetry registry (Prometheus text
+        on its internal port) into the last-good cache. Bounded per-target
+        timeout: a dead/wedged worker costs one socket timeout per cycle,
+        never a hang — and its last good section keeps serving with a
+        growing staleness gauge."""
+        period = self.cfg.fleet.scrape_period_s
+        while not self._shutdown.wait(period):
+            for slot in self._slots:
+                lease = slot.lease
+                if slot.state == ALIVE and lease is not None:
+                    ok = self._scrape.scrape(slot.index, lease.port)
+                    # close the scrape/retire race: a GET in flight when the
+                    # monitor retires the slot (and forgets its section)
+                    # would otherwise re-insert the dead worker's metrics
+                    # with nothing left to ever clear them
+                    if ok and slot.state == RETIRED:
+                        self._scrape.forget(slot.index)
+
+    def prometheus_merged(self) -> str:
+        """The fleet-wide ``/metrics?format=prometheus`` document: the
+        supervisor's own registry (admission, journal, SLO gauges) plus every
+        worker's scraped registry with a ``worker="N"`` label on each series,
+        plus per-worker up/staleness gauges. Built entirely from cached
+        scrapes — never blocks on a worker."""
+        status_doc = dict(self.status())
+        for key in ("workers", "role", "health"):   # non-numeric
+            status_doc.pop(key, None)
+        tracing.update_gauges(status_doc, prefix="serve/")
+        sections = [tracing.registry().prometheus_text()]
+        scraped = self._scrape.snapshot()
+        # staleness threshold is CYCLE-aware: the scrape loop is sequential,
+        # so one full cycle can cost period + one timeout per wedged worker —
+        # a fixed multiple of the period alone would flap worker_up to 0 on
+        # healthy workers whenever siblings are timing out. A truly dead
+        # worker still drops out of `up` immediately via slot.state.
+        f = self.cfg.fleet
+        stale_after = (3 * max(f.scrape_period_s, f.scrape_timeout_s)
+                       + len(self._slots) * f.scrape_timeout_s)
+        up_lines = [
+            "# HELP dcr_fleet_worker_up 1 when the slot is ALIVE and its "
+            "last scrape is fresh",
+            "# TYPE dcr_fleet_worker_up gauge",
+            "# HELP dcr_fleet_worker_scrape_age_seconds age of the worker's "
+            "last successful registry scrape",
+            "# TYPE dcr_fleet_worker_scrape_age_seconds gauge",
+        ]
+        for slot in self._slots:
+            label = {"worker": str(slot.index)}
+            text_age = scraped.get(slot.index)
+            fresh = text_age is not None and text_age[1] <= stale_after
+            up = 1 if (slot.state == ALIVE and fresh) else 0
+            up_lines.append(inject_labels(
+                f"dcr_fleet_worker_up {up}", label).rstrip("\n"))
+            if text_age is not None:
+                up_lines.append(inject_labels(
+                    f"dcr_fleet_worker_scrape_age_seconds "
+                    f"{round(text_age[1], 3)}", label).rstrip("\n"))
+                sections.append(inject_labels(text_age[0], label))
+        sections.insert(1, "\n".join(up_lines) + "\n")
+        return merge_expositions(sections)
+
+    # -- on-demand device profiling ------------------------------------------
+
+    def profile(self, body: dict) -> dict:
+        """``POST /debug/profile`` routed to a worker: arm a jax.profiler
+        capture around that worker's next K device steps. Body
+        ``{"worker"?: int, "steps"?: int, "logdir"?: str}``; default target
+        is the first ALIVE worker."""
+        target = body.get("worker")
+        with self._lock:
+            alive = {s.index: s.lease for s in self._slots
+                     if s.state == ALIVE and s.lease is not None}
+        if target is None:
+            if not alive:
+                raise NoWorkersError("no ALIVE worker to profile")
+            target = min(alive)
+        target = int(target)
+        if target not in alive:
+            raise ValueError(f"worker {target} is not ALIVE "
+                             f"(alive: {sorted(alive)})")
+        fwd = {k: body[k] for k in ("steps", "logdir") if k in body}
+        status, doc = _post_json(self.cfg.host, alive[target].port,
+                                 "/debug/profile", fwd,
+                                 self.cfg.fleet.scrape_timeout_s)
+        if status != 200:
+            raise RuntimeError(
+                f"worker {target} rejected profile arm ({status}): {doc!r}")
+        self._last_profile_worker = target
+        return {**doc, "worker": target}
+
+    def profile_status(self) -> dict:
+        """``GET /debug/profile``: the armed worker's capture status."""
+        target = self._last_profile_worker
+        if target is None:
+            return {"armed": False, "worker": None}
+        with self._lock:
+            slot = self._slots[target]
+            lease = slot.lease if slot.state == ALIVE else None
+        if lease is None:
+            return {"armed": False, "worker": target,
+                    "error": f"worker {target} is no longer alive"}
+        try:
+            status, text = http_get_text(self.cfg.host, lease.port,
+                                         "/debug/profile",
+                                         self.cfg.fleet.scrape_timeout_s)
+            doc = json.loads(text) if status == 200 else {"error": text}
+        except (OSError, ValueError, http.client.HTTPException) as e:
+            doc = {"armed": False, "error": repr(e)}
+        return {**doc, "worker": target}
 
     def _fail_fleet(self) -> None:
         """Every slot exhausted its respawn budget: fail pending work loudly
@@ -528,7 +688,8 @@ class FleetSupervisor:
         that a survivor would serve identically."""
         keep: list[Request] = []
         with tracing.span("serve/requeue", worker=worker, n=len(reqs),
-                          reason=reason):
+                          reason=reason,
+                          trace_ids=[r.trace_id for r in reqs]):
             for req in reqs:
                 attempts = self.journal.requeue(req.id, worker, reason,
                                                 charge=charge)
@@ -589,10 +750,15 @@ class FleetSupervisor:
                 retry_after_s=f.shed_retry_after_s)
 
     def submit(self, prompt: str, *, seed: int = 0,
-               bucket: Optional[GenBucket] = None) -> Request:
+               bucket: Optional[GenBucket] = None,
+               trace_ctx: Optional[dict] = None) -> Request:
         """Admit into the fleet queue. Same typed-rejection contract as
         GenerationService.submit, plus :class:`SloShedError` (503 +
-        Retry-After) and :class:`NoWorkersError` (fleet warming/failed)."""
+        Retry-After) and :class:`NoWorkersError` (fleet warming/failed).
+        ``trace_ctx`` exists for signature duck-compat with
+        GenerationService; a supervisor is the trace ROOT, so an incoming
+        context is ignored (fleets do not nest)."""
+        del trace_ctx
         f = self.cfg.fleet
         bucket = bucket or self.default_bucket()
         try:
@@ -620,7 +786,13 @@ class FleetSupervisor:
                 self._admitted_buckets.add(bucket)
             req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
                           bucket=bucket)
+            # the distributed-trace root: the id travels with the request
+            # through the journal and every dispatched batch, and survives
+            # requeue-after-worker-death unchanged (attempts become sibling
+            # child spans under this root)
+            req.trace_id = tracing.new_trace_id()
             root = tracing.begin_span("serve/request", parent=None,
+                                      trace=req.trace_id,
                                       request_id=req.id, seed=req.seed,
                                       bucket=str(tuple(bucket)))
             req.span = root
@@ -766,6 +938,11 @@ class FleetSupervisor:
                     R.bump_counter("fleet_kill_errors")
         if self._monitor is not None:
             self._monitor.join(timeout=5 * self._poll_s)
+        if self._scraper is not None:
+            # the loop's wait() observes _shutdown within one scrape period;
+            # an in-flight scrape is bounded by its socket timeout
+            self._scraper.join(timeout=self.cfg.fleet.scrape_period_s
+                               + 2 * self.cfg.fleet.scrape_timeout_s)
         self.journal.close()
 
     # -- introspection -------------------------------------------------------

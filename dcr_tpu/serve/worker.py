@@ -49,6 +49,7 @@ from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket,
                                  InvalidRequestError, Request, RequestQueue)
+from dcr_tpu.utils import profiling
 
 log = logging.getLogger("dcr_tpu")
 
@@ -290,11 +291,20 @@ class GenerationService:
                          rand_noise_lam=c.rand_noise_lam)
 
     def submit(self, prompt: str, *, seed: int = 0,
-               bucket: Optional[GenBucket] = None) -> Request:
+               bucket: Optional[GenBucket] = None,
+               trace_ctx: Optional[dict] = None) -> Request:
         """Admit a request. Typed AdmissionError on every rejection path:
         InvalidRequestError (bad bucket params), BucketLimitError (would
         compile past the resident-program budget), QueueFullError (overload),
-        DrainingError (SIGTERM seen)."""
+        DrainingError (SIGTERM seen).
+
+        ``trace_ctx`` is the distributed trace context a fleet supervisor
+        ships with a dispatched batch (:func:`dcr_tpu.core.tracing.
+        wire_context`): when present, this worker's ``serve/request`` span
+        joins the supervisor's trace — same trace id, ``remote_parent``
+        naming the supervisor root span, ``attempt`` tagging requeued
+        re-executions as siblings — instead of starting a disconnected tree.
+        """
         bucket = bucket or self.default_bucket()
         try:
             validate_bucket(bucket, vae_scale=self._vae_scale)
@@ -309,6 +319,15 @@ class GenerationService:
                 self._admitted_buckets.add(bucket)
             req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
                           bucket=bucket)
+            trace_attrs: dict = {}
+            if trace_ctx and trace_ctx.get("trace_id"):
+                req.trace_id = str(trace_ctx["trace_id"])
+                if trace_ctx.get("parent_span") is not None:
+                    trace_attrs["remote_parent"] = int(trace_ctx["parent_span"])
+                if trace_ctx.get("attempt") is not None:
+                    trace_attrs["attempt"] = int(trace_ctx["attempt"])
+            else:
+                req.trace_id = tracing.new_trace_id()
             # root of this request's span tree (admission -> queue wait ->
             # device step -> respond), closed by the future callback whichever
             # thread resolves it — so the root span's duration IS the
@@ -317,8 +336,9 @@ class GenerationService:
             # read req.span before this thread runs another line. A rejected
             # request's handle is simply never ended (nothing is recorded).
             root = tracing.begin_span("serve/request", parent=None,
+                                      trace=req.trace_id,
                                       request_id=req.id, seed=req.seed,
-                                      bucket=str(tuple(bucket)))
+                                      bucket=str(tuple(bucket)), **trace_attrs)
             req.span = root
             self.queue.submit(req)
         except AdmissionError as e:
@@ -414,21 +434,30 @@ class GenerationService:
             raise ValueError(f"batch of {n} exceeds max_batch={self.cfg.max_batch}")
         fn = self._sampler_for(bucket)
         ids = [r.id for r in requests]
+        traces = [r.trace_id for r in requests]
         # batch assembly: tokenize + text tower (or cache hit) + padding.
-        # Batch-level spans carry the member request ids; the per-request
-        # children (queue wait, respond) parent on each request's root span.
-        with tracing.span("serve/assemble", batch=n, request_ids=ids):
+        # Batch-level spans carry the member request ids AND trace ids (the
+        # fleet merge attributes batch time to each member's tree through
+        # them); the per-request children (queue wait, respond) parent on
+        # each request's root span.
+        with tracing.span("serve/assemble", batch=n, request_ids=ids,
+                          trace_ids=traces):
             mitigation = mitigation_tag(bucket)
             uncond_row = self._uncond_embedding()
             cond = np.stack([self._cond_embedding(r, mitigation) for r in requests]
                             + [uncond_row] * pad)
             uncond = np.stack([uncond_row] * self.cfg.max_batch)
             seeds = np.asarray([r.seed for r in requests] + [0] * pad, np.uint32)
-        with tracing.span("serve/device_step", batch=n, request_ids=ids,
-                          bucket=str(tuple(bucket))):
-            # np.asarray forces the transfer, so this span closes only when
-            # the device work is actually done — real step time, not dispatch
-            images = np.asarray(fn(self.stack.params, cond, uncond, seeds))
+        # profiling.capture is a no-op unless /debug/profile (or the trainer's
+        # DCR_PROFILE_AT_STEP) armed a jax.profiler window over the next K
+        # device steps
+        with profiling.capture():
+            with tracing.span("serve/device_step", batch=n, request_ids=ids,
+                              trace_ids=traces, bucket=str(tuple(bucket))):
+                # np.asarray forces the transfer, so this span closes only when
+                # the device work is actually done — real step time, not
+                # dispatch
+                images = np.asarray(fn(self.stack.params, cond, uncond, seeds))
         return images[:n]
 
     # -- the drain loop ------------------------------------------------------
@@ -471,7 +500,7 @@ class GenerationService:
             tracing.complete_span(
                 "serve/queue_wait", start_wall=now_wall - waited, dur_s=waited,
                 parent=req.span.id if req.span is not None else None,
-                request_id=req.id)
+                trace=req.trace_id, request_id=req.id)
         try:
             # the watchdog turns a wedged device step into a structured
             # post-mortem + EXIT_HANG instead of a silently dead port
@@ -531,6 +560,28 @@ class GenerationService:
                     if not req.future.done():
                         req.future.set_exception(e)
         log.info("serve: worker drained and stopped")
+
+    # -- on-demand device profiling ------------------------------------------
+
+    def profile(self, body: dict) -> dict:
+        """Arm a ``jax.profiler`` capture around the next K
+        ``serve/device_step`` executions (``POST /debug/profile``). Body:
+        ``{"steps"?: int, "logdir"?: str}``. Returns the armed status doc
+        including the artifact directory the trace will land in; poll
+        ``GET /debug/profile`` until ``artifact`` is set."""
+        steps = int(body.get("steps", 1))
+        logdir = body.get("logdir")
+        if not logdir:
+            base = tracing.trace_dir()
+            if base is None:
+                raise ValueError(
+                    "no profile destination: pass 'logdir' or run the "
+                    "worker with --logdir")
+            logdir = str(base / "profile")
+        return profiling.arm(logdir, steps)
+
+    def profile_status(self) -> dict:
+        return profiling.status()
 
     # -- introspection -------------------------------------------------------
 

@@ -9,6 +9,7 @@ model-FLOPs-utilization accounting against the chip's peak.
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -43,6 +44,118 @@ def trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+class _ProfileArmer:
+    """On-demand device profiling: arm once, capture the next K hot regions.
+
+    The imperative sibling of :func:`trace` for long-lived processes where
+    nobody can wrap the hot loop in a ``with`` block after the fact: a serve
+    worker arms via ``POST /debug/profile``, the trainer via
+    ``DCR_PROFILE_AT_STEP`` — both then pass every hot region (device step /
+    train step) through :meth:`capture`, which starts the jax.profiler trace
+    on the first armed region, counts K regions, and stops. Unarmed,
+    :meth:`capture` is two attribute reads — safe to leave permanently in
+    the hot path.
+
+    Profiler failures (an unsupported backend, a second concurrent session)
+    disarm loudly into ``status()['error']`` instead of breaking the region
+    they wrap: profiling must never fail the workload it measures."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._logdir: Optional[str] = None
+        self._remaining = 0
+        self._active = False
+        self._artifact: Optional[str] = None
+        self._error: Optional[str] = None
+
+    def arm(self, logdir: str, steps: int = 1) -> dict:
+        if steps < 1:
+            raise ValueError(f"profile steps must be >= 1, got {steps}")
+        with self._lock:
+            if self._remaining or self._active:
+                raise RuntimeError(
+                    f"profiler already armed ({self._remaining} step(s) "
+                    f"remaining into {self._logdir})")
+            self._logdir = str(logdir)
+            self._remaining = int(steps)
+            self._artifact = None
+            self._error = None
+        return self.status()
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "armed": bool(self._remaining or self._active),
+                "remaining": self._remaining,
+                "logdir": self._logdir,
+                "artifact": self._artifact,
+                "error": self._error,
+            }
+
+    @contextlib.contextmanager
+    def capture(self):
+        """Pass one hot region through the armer. Starts the profiler trace
+        when armed and not yet started; after the K-th region, stops it and
+        records the artifact path."""
+        if not self._remaining and not self._active:   # fast path: unarmed
+            yield
+            return
+        start = False
+        with self._lock:
+            if self._remaining > 0 and not self._active:
+                self._active = True
+                start = True
+            logdir = self._logdir
+        if start:
+            try:
+                jax.profiler.start_trace(logdir)
+            except Exception as e:      # profiler failure must not fail serving
+                with self._lock:
+                    self._active = False
+                    self._remaining = 0
+                    self._error = repr(e)
+                yield
+                return
+        try:
+            yield
+        finally:
+            stop = False
+            with self._lock:
+                if self._active and self._remaining > 0:
+                    self._remaining -= 1
+                    if self._remaining == 0:
+                        stop = True
+            if stop:
+                try:
+                    jax.profiler.stop_trace()
+                    with self._lock:
+                        self._active = False
+                        self._artifact = logdir
+                except Exception as e:
+                    with self._lock:
+                        self._active = False
+                        self._error = repr(e)
+
+
+_armer = _ProfileArmer()
+
+
+def arm(logdir: str, steps: int = 1) -> dict:
+    """Arm the process-wide profiler for the next ``steps`` captured regions
+    (serve ``/debug/profile``, trainer ``DCR_PROFILE_AT_STEP``)."""
+    return _armer.arm(logdir, steps)
+
+
+def status() -> dict:
+    return _armer.status()
+
+
+def capture():
+    """Context manager every profileable hot region wraps itself in; no-op
+    unless :func:`arm` ran."""
+    return _armer.capture()
 
 
 def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:

@@ -1,0 +1,826 @@
+"""dcr-scope tests: fleet-wide tracing, metrics aggregation, profiling.
+
+Fast tier: trace-file rotation (size cap, keep-N, report reads segments),
+Prometheus exposition hygiene (HELP/TYPE headers, sanitized identifiers,
+non-finite value tokens — validated with a strict format checker), the
+wire-context round-trip through the request journal (requeue keeps the
+trace id, increments attempt), worker-indexed flight-recorder filenames,
+LatencyTracker under concurrent observe(), the scrape/label/merge helpers
+(inject_labels, merge_expositions, ScrapeCache against a real socket), the
+supervisor's merged exposition built purely from the scrape cache, the
+profile armer state machine, and trace_report's fleet merge (clock-offset
+anchoring, cross-process span trees, requeue attempts, orphan accounting,
+per-process Chrome tracks) over synthetic multi-process trace files.
+
+Slow tier (CI `observability` job): the dcr-scope acceptance e2e — a real
+2-worker fleet with an injected ``worker_crash``, then (a) the merged
+``/metrics?format=prometheus`` carries worker-labeled series and
+up/staleness gauges from live workers without blocking on the dead one,
+(b) a ``POST /debug/profile`` round-trip produces a readable jax.profiler
+artifact, and (c) ``tools/trace_report`` over the fleet dir reconstructs
+one connected span tree per request — including the requeued-after-crash
+request as an attempt-tagged sibling under the same root.
+"""
+
+import json
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from dcr_tpu.core import tracing
+from dcr_tpu.serve.scrape import (ScrapeCache, inject_labels,
+                                  merge_expositions)
+from tools import trace_report
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset_for_tests()
+    yield
+    tracing.reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# trace.jsonl size-capped rotation
+# ---------------------------------------------------------------------------
+
+def _emit_events(n: int, payload: str = "x" * 120) -> None:
+    for i in range(n):
+        tracing.event("rotation_test", i=i, payload=payload)
+
+
+@pytest.mark.fast
+def test_trace_rotation_caps_file_and_report_reads_segments(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("DCR_TRACE_MAX_MB", "0.003")      # 3000 bytes
+    monkeypatch.setenv("DCR_TRACE_KEEP", "3")
+    path = tracing.configure(tmp_path, rank=0)
+    _emit_events(25)
+    tracing.reset_for_tests()
+    segments = sorted(p.name for p in tmp_path.iterdir()
+                      if p.name.startswith("trace.jsonl"))
+    assert "trace.jsonl.1" in segments                   # rotation happened
+    assert len(segments) >= 2
+    # the live file never grows past the cap by more than one record
+    assert path.stat().st_size <= 3000 + 400
+    # trace_report reads base + rotated segments as one stream, no loss
+    records, errors = trace_report.load_trace(tmp_path, trace_report.load_schema())
+    assert not errors
+    assert [r["args"]["i"] for r in records] == list(range(25))
+    assert {r["_plabel"] for r in records} == {"trace.jsonl"}
+
+
+@pytest.mark.fast
+def test_trace_rotation_drops_oldest_beyond_keep(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCR_TRACE_MAX_MB", "0.001")      # 1000 bytes
+    monkeypatch.setenv("DCR_TRACE_KEEP", "1")
+    tracing.configure(tmp_path, rank=0)
+    _emit_events(40)
+    tracing.reset_for_tests()
+    segments = {p.name for p in tmp_path.iterdir()
+                if p.name.startswith("trace.jsonl")}
+    assert segments <= {"trace.jsonl", "trace.jsonl.1"}   # .2 never appears
+    records, errors = trace_report.load_trace(tmp_path, trace_report.load_schema())
+    assert not errors
+    # oldest records were dropped with their segment, newest survive in order
+    idx = [r["args"]["i"] for r in records]
+    assert idx == sorted(idx) and idx[-1] == 39 and len(idx) < 40
+
+
+@pytest.mark.fast
+def test_rotation_reconfigure_resumes_byte_accounting(tmp_path, monkeypatch):
+    """configure() on an existing file seeds bytes_written from its size, so
+    a restarted process keeps honoring the cap instead of starting from 0."""
+    monkeypatch.setenv("DCR_TRACE_MAX_MB", "0.001")
+    tracing.configure(tmp_path, rank=0)
+    _emit_events(5)
+    tracing.reset_for_tests()
+    monkeypatch.setenv("DCR_TRACE_MAX_MB", "0.001")
+    tracing.configure(tmp_path, rank=0)                  # "restart"
+    assert tracing._state.bytes_written > 0
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition hygiene
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)'
+    r'(?:\{(?P<labels>[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*"'
+    r'(?:,[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\\n]|\\.)*")*)\})?'
+    r' (?P<value>-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+
+
+def _assert_valid_exposition(text: str) -> dict[str, str]:
+    """Strict-enough exposition-format check: every line is a HELP/TYPE
+    comment or a sample; identifiers are legal; one TYPE per metric, HELP
+    precedes it; every sample belongs to a declared metric family. Returns
+    {sample line name+labels: value string}."""
+    typed: dict[str, str] = {}
+    helped: set[str] = set()
+    samples: dict[str, str] = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ", 3)[2]
+            assert _NAME_RE.match(name), line
+            assert name not in helped, f"duplicate HELP: {line}"
+            helped.add(name)
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert _NAME_RE.match(name), line
+            assert kind in ("counter", "gauge", "summary"), line
+            assert name not in typed, f"duplicate TYPE: {line}"
+            assert name in helped, f"TYPE without preceding HELP: {line}"
+            typed[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = m.group("name")
+        family = (base.rsplit("_", 1)[0]
+                  if base.endswith(("_sum", "_count")) else base)
+        assert base in typed or family in typed, \
+            f"sample without TYPE header: {line!r}"
+        samples[line.rsplit(" ", 1)[0]] = m.group("value")
+    return samples
+
+
+@pytest.mark.fast
+def test_prometheus_text_is_format_valid_with_hostile_names():
+    reg = tracing.registry()
+    reg.counter("faults/weird-kind.x").inc(2)
+    reg.gauge("stage/eval time (s)").set(1.5)
+    reg.gauge("serve/inf_gauge").set(float("inf"))
+    reg.gauge("serve/nan_gauge").set(float("nan"))
+    h = reg.histogram("serve/latency s", window=8)
+    h.observe(0.5)
+    text = reg.prometheus_text()
+    samples = _assert_valid_exposition(text)
+    assert samples["dcr_faults_weird_kind_x"] == "2"
+    assert samples["dcr_faults_total"] == "2"
+    assert samples["dcr_stage_eval_time__s_"] == "1.5"
+    assert samples["dcr_serve_inf_gauge"] == "+Inf"
+    assert samples["dcr_serve_nan_gauge"] == "NaN"
+    assert 'dcr_serve_latency_s{quantile="0.50"}' in samples
+    # HELP lines name the internal metric the identifier was sanitized from
+    assert "# HELP dcr_faults_weird_kind_x" in text
+    assert "'faults/weird-kind.x'" in text
+
+
+@pytest.mark.fast
+def test_sanitize_and_value_helpers():
+    assert tracing.sanitize_metric_name("faults/x-y.z") == "dcr_faults_x_y_z"
+    assert _NAME_RE.match(tracing.sanitize_metric_name("0weird"))
+    assert tracing.sanitize_label_name("9worker") == "_9worker"
+    assert tracing.sanitize_label_name("wor-ker") == "wor_ker"
+    assert tracing.prometheus_value(float("inf")) == "+Inf"
+    assert tracing.prometheus_value(float("-inf")) == "-Inf"
+    assert tracing.prometheus_value(float("nan")) == "NaN"
+    assert tracing.prometheus_value(3) == "3"
+    assert float(tracing.prometheus_value(0.25)) == 0.25
+
+
+@pytest.mark.fast
+def test_colliding_sanitized_names_share_one_header():
+    reg = tracing.registry()
+    reg.gauge("serve/a-b").set(1.0)
+    reg.gauge("serve/a.b").set(2.0)          # sanitizes to the same identifier
+    _assert_valid_exposition(reg.prometheus_text())   # no duplicate TYPE
+
+
+# ---------------------------------------------------------------------------
+# distributed trace context: wire format + journal round-trip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_wire_context_carries_trace_and_attempt(tmp_path):
+    tracing.configure(tmp_path, rank=0)
+    tid = tracing.new_trace_id()
+    assert re.fullmatch(r"[0-9a-f]{16}", tid)
+    assert tracing.new_trace_id() != tid
+    root = tracing.begin_span("serve/request", parent=None, trace=tid)
+    ctx = tracing.wire_context(root, attempt=2)
+    assert ctx == {"trace_id": tid, "parent_span": root.id, "attempt": 2}
+    root.end()
+    [rec] = tracing.flight_records()
+    assert rec["trace"] == tid
+
+
+@pytest.mark.fast
+def test_span_inherits_trace_via_contextvars(tmp_path):
+    tracing.configure(tmp_path, rank=0)
+    tid = tracing.new_trace_id()
+    with tracing.span("serve/request", trace=tid):
+        with tracing.span("serve/inner"):
+            tracing.event("serve/mark")
+        assert tracing.current_trace_id() == tid
+    assert tracing.current_trace_id() is None
+    recs = {r["name"]: r for r in tracing.flight_records()}
+    assert recs["serve/inner"]["trace"] == tid
+    assert recs["serve/mark"]["trace"] == tid
+
+
+@pytest.mark.fast
+def test_journal_round_trips_trace_id_across_requeue(tmp_path):
+    from dcr_tpu.serve.fleet import RequestJournal
+    from dcr_tpu.serve.queue import GenBucket, Request
+
+    bucket = GenBucket(resolution=16, steps=2, guidance=7.5, sampler="ddim",
+                       rand_noise_lam=0.0)
+    req = Request(prompt="p", seed=0, bucket=bucket)
+    req.trace_id = tracing.new_trace_id()
+    path = tmp_path / "journal.jsonl"
+    j = RequestJournal(path)
+    e = j.add(req)
+    assert e.trace_id == req.trace_id
+    assert j.dispatch(req.id, worker=0) == 1
+    # worker died: requeue keeps the trace id, the NEXT dispatch is attempt 2
+    j.requeue(req.id, worker=0, reason="crash")
+    assert j.entry(req.id).trace_id == req.trace_id
+    assert j.dispatch(req.id, worker=1) == 2
+    j.ack(req.id, worker=1)
+    j.close()
+    add = [json.loads(l) for l in path.read_text().splitlines()
+           if json.loads(l)["op"] == "add"]
+    assert add[0]["trace"] == req.trace_id       # durable: survives replay
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: worker-indexed filenames
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_flight_recorder_filename_includes_worker_index(tmp_path, monkeypatch):
+    monkeypatch.setenv("DCR_WORKER_INDEX", "3")
+    tracing.configure(tmp_path, rank=0)
+    tracing.event("about_to_die")
+    path = tracing.dump_flight_recorder("worker 3 post-mortem")
+    assert path == tmp_path / "flightrec_w3_0.json"
+    assert json.loads(path.read_text())["reason"] == "worker 3 post-mortem"
+
+
+@pytest.mark.fast
+def test_flight_recorder_plain_name_without_worker_index(tmp_path, monkeypatch):
+    monkeypatch.delenv("DCR_WORKER_INDEX", raising=False)
+    tracing.configure(tmp_path, rank=0)
+    assert tracing.dump_flight_recorder("x") == tmp_path / "flightrec_0.json"
+
+
+# ---------------------------------------------------------------------------
+# LatencyTracker / histogram under concurrency
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_latency_tracker_concurrent_observe_and_percentiles():
+    from dcr_tpu.core.metrics import LatencyTracker
+
+    lt = LatencyTracker(name="scope/concurrency_test", window=256)
+    errors: list = []
+
+    def observer(base):
+        try:
+            for i in range(500):
+                lt.observe(base + i / 1000.0)
+        except Exception as e:                        # pragma: no cover
+            errors.append(e)
+
+    def reader():
+        try:
+            for _ in range(200):
+                p = lt.percentiles((50, 99))
+                assert p["p99"] >= p["p50"] >= 0.0
+        except Exception as e:                        # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=observer, args=(w,)) for w in range(6)]
+    threads += [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    snap = lt.snapshot()
+    assert snap["count"] == 3000                      # no lost observations
+    assert snap["sum"] == pytest.approx(
+        sum(w + i / 1000.0 for w in range(6) for i in range(500)))
+    assert 0.0 <= snap["p50"] <= 6.0
+
+
+# ---------------------------------------------------------------------------
+# scrape helpers: label injection, exposition merge, bounded scraping
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_inject_labels_extends_and_creates_label_sets():
+    text = ("# HELP dcr_x help\n# TYPE dcr_x counter\n"
+            "dcr_x 3\n"
+            'dcr_lat{quantile="0.99"} 0.5\n')
+    out = inject_labels(text, {"worker": "1"})
+    assert 'dcr_x{worker="1"} 3' in out
+    assert 'dcr_lat{quantile="0.99",worker="1"} 0.5' in out
+    assert "# HELP dcr_x help" in out                 # comments untouched
+    # label values escape quotes/backslashes; names sanitize
+    out = inject_labels("m 1\n", {"wor-ker": 'a"b\\c'})
+    assert out == 'm{wor_ker="a\\"b\\\\c"} 1\n'
+
+
+@pytest.mark.fast
+def test_merge_expositions_dedupes_headers_keeps_samples():
+    a = ('# HELP dcr_x h\n# TYPE dcr_x counter\ndcr_x{worker="0"} 1\n')
+    b = ('# HELP dcr_x h\n# TYPE dcr_x counter\ndcr_x{worker="1"} 2\n')
+    merged = merge_expositions([a, b])
+    assert merged.count("# TYPE dcr_x counter") == 1
+    assert 'dcr_x{worker="0"} 1' in merged and 'dcr_x{worker="1"} 2' in merged
+    _assert_valid_exposition(merged)
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    payload = b"# HELP dcr_up h\n# TYPE dcr_up gauge\ndcr_up 1\n"
+
+    def do_GET(self):                                 # noqa: N802 (stdlib API)
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(self.payload)))
+        self.end_headers()
+        self.wfile.write(self.payload)
+
+    def log_message(self, fmt, *args):
+        pass
+
+
+@pytest.mark.fast
+def test_scrape_cache_last_good_text_and_bounded_failure():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _MetricsHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    try:
+        port = server.server_address[1]
+        cache = ScrapeCache("127.0.0.1", timeout_s=1.0)
+        assert cache.scrape(0, port) is True
+        snap = cache.snapshot()
+        text, age = snap[0]
+        assert "dcr_up 1" in text and age < 5.0
+        # a dead worker: quick typed failure, last-good cache untouched
+        with ThreadingHTTPServer(("127.0.0.1", 0), _MetricsHandler) as tmp:
+            dead_port = tmp.server_address[1]
+        t0 = time.monotonic()
+        assert cache.scrape(1, dead_port) is False
+        assert time.monotonic() - t0 < 5.0            # bounded, no hang
+        assert 1 not in cache.snapshot()
+        assert tracing.registry().counter("fleet/scrape_errors").value >= 1
+        cache.forget(0)
+        assert cache.snapshot() == {}
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+@pytest.mark.fast
+def test_supervisor_merged_exposition_from_cache_only(tmp_path):
+    """prometheus_merged builds the fleet document from the scrape cache and
+    slot states alone — no sockets — with worker-labeled series, staleness
+    gauges, up=0 for a dead slot, and deduplicated headers."""
+    from dcr_tpu.core.config import FleetConfig, ServeConfig
+    from dcr_tpu.serve.supervisor import ALIVE, FleetSupervisor
+
+    cfg = ServeConfig(resolution=16, num_inference_steps=2, sampler="ddim",
+                      fleet=FleetConfig(workers=2, dir=str(tmp_path)))
+    sup = FleetSupervisor(cfg)                        # never started
+    try:
+        worker_text = ("# HELP dcr_serve_completed_total h\n"
+                       "# TYPE dcr_serve_completed_total counter\n"
+                       "dcr_serve_completed_total 4\n")
+        sup._slots[0].state = ALIVE
+        sup._scrape._cache = {0: (worker_text, time.time()),
+                              1: (worker_text, time.time() - 3600.0)}
+        merged = sup.prometheus_merged()
+        samples = _assert_valid_exposition(merged)
+        assert samples['dcr_serve_completed_total{worker="0"}'] == "4"
+        assert samples['dcr_fleet_worker_up{worker="0"}'] == "1"
+        # slot 1 never went ALIVE and its scrape is an hour stale: down,
+        # but its last-good numbers still serve with a loud age
+        assert samples['dcr_fleet_worker_up{worker="1"}'] == "0"
+        assert float(
+            samples['dcr_fleet_worker_scrape_age_seconds{worker="1"}']) > 1000
+        assert samples['dcr_serve_completed_total{worker="1"}'] == "4"
+        # supervisor-side SLO gauges ride the same document
+        sup._update_slo_gauges(alive=1)
+        samples = _assert_valid_exposition(sup.prometheus_merged())
+        assert samples["dcr_fleet_availability"] == "0.5"
+        assert "dcr_fleet_shed_rate" in samples
+    finally:
+        sup.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# on-demand profiling: the armer state machine (profiler stubbed)
+# ---------------------------------------------------------------------------
+
+def _stub_profiler(monkeypatch, calls, fail_start=False):
+    from dcr_tpu.utils import profiling
+
+    def start_trace(logdir):
+        if fail_start:
+            raise RuntimeError("profiler unsupported here")
+        calls.append(("start", logdir))
+
+    monkeypatch.setattr(profiling.jax.profiler, "start_trace", start_trace)
+    monkeypatch.setattr(profiling.jax.profiler, "stop_trace",
+                        lambda: calls.append(("stop", None)))
+
+
+@pytest.mark.fast
+def test_profile_armer_captures_k_steps_then_disarms(monkeypatch, tmp_path):
+    from dcr_tpu.utils.profiling import _ProfileArmer
+
+    calls: list = []
+    _stub_profiler(monkeypatch, calls)
+    armer = _ProfileArmer()
+    with armer.capture():                             # unarmed: pure no-op
+        pass
+    assert calls == [] and armer.status()["armed"] is False
+    doc = armer.arm(str(tmp_path), steps=2)
+    assert doc["armed"] is True and doc["remaining"] == 2
+    with pytest.raises(RuntimeError, match="already armed"):
+        armer.arm(str(tmp_path))
+    with pytest.raises(ValueError):
+        armer.arm(str(tmp_path), steps=0)
+    with armer.capture():
+        pass
+    assert armer.status()["remaining"] == 1           # started, still open
+    with armer.capture():
+        pass
+    assert calls == [("start", str(tmp_path)), ("stop", None)]
+    status = armer.status()
+    assert status["armed"] is False
+    assert status["artifact"] == str(tmp_path)
+    assert status["error"] is None
+    armer.arm(str(tmp_path), steps=1)                 # re-armable after done
+    with armer.capture():
+        pass
+    assert calls.count(("stop", None)) == 2
+
+
+@pytest.mark.fast
+def test_profile_armer_failure_disarms_without_breaking_region(
+        monkeypatch, tmp_path):
+    from dcr_tpu.utils.profiling import _ProfileArmer
+
+    calls: list = []
+    _stub_profiler(monkeypatch, calls, fail_start=True)
+    armer = _ProfileArmer()
+    armer.arm(str(tmp_path), steps=3)
+    ran = []
+    with armer.capture():
+        ran.append(True)                              # the hot region RUNS
+    assert ran == [True]
+    status = armer.status()
+    assert status["armed"] is False and "unsupported" in status["error"]
+    with armer.capture():                             # back to no-op
+        pass
+    assert calls == []
+
+
+# ---------------------------------------------------------------------------
+# trace_report fleet merge over synthetic multi-process traces
+# ---------------------------------------------------------------------------
+
+def _rec(name, id, ts, *, ph="X", dur=1000, parent=None, trace=None,
+         args=None):
+    rec = {"ph": ph, "name": name, "id": id, "parent": parent, "ts": ts,
+           "pid": 0, "tid": 1, "tname": "t", "args": args or {}}
+    if ph == "X":
+        rec["dur"] = dur
+    if trace is not None:
+        rec["trace"] = trace
+    return rec
+
+
+def _write(path: Path, records) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text("".join(json.dumps(r) + "\n" for r in records))
+
+
+_T0 = 1_700_000_000_000_000                           # an arbitrary epoch us
+
+
+def _write_fleet_dir(tmp_path: Path, *, skew_us=0) -> Path:
+    """Supervisor + 2 workers. Trace A is dispatched to worker 0 (which dies
+    mid-batch: its root span never lands, leaving an orphan queue_wait),
+    requeued to worker 1 as attempt 2. Trace B runs on worker 1 whose clock
+    is ``skew_us`` BEHIND the supervisor's."""
+    a, b = "aaaa000000000001", "bbbb000000000002"
+    sup = [
+        _rec("serve/request", 1, _T0, dur=400_000, trace=a,
+             args={"request_id": 1}),
+        _rec("serve/queue_wait", 2, _T0 + 1_000, parent=1, trace=a,
+             args={"request_id": 1}),
+        _rec("fleet/dispatch", 3, _T0 + 5_000, dur=60_000,
+             args={"worker": 0, "trace_ids": [a]}),
+        _rec("fleet/dispatch", 4, _T0 + 80_000, dur=90_000,
+             args={"worker": 1, "trace_ids": [a]}),     # requeued re-dispatch
+        _rec("serve/request", 5, _T0 + 2_000, dur=300_000, trace=b,
+             args={"request_id": 2}),
+        _rec("fleet/dispatch", 6, _T0 + 10_000, dur=80_000,
+             args={"worker": 1, "trace_ids": [b]}),
+    ]
+    # worker 0 was SIGKILLed before its serve/request root (id=9) ended:
+    # only the retroactive queue_wait landed — parent id 9 never written
+    w0 = [
+        _rec("serve/queue_wait", 10, _T0 + 8_000, parent=9, trace=a,
+             args={"request_id": 1}),
+    ]
+    w1 = [
+        _rec("serve/request", 1, _T0 + 85_000 - skew_us, dur=80_000, trace=a,
+             args={"remote_parent": 1, "attempt": 2, "request_id": 1}),
+        _rec("serve/queue_wait", 2, _T0 + 86_000 - skew_us, parent=1, trace=a,
+             args={"request_id": 1}),
+        _rec("serve/assemble", 3, _T0 + 87_000 - skew_us, dur=5_000,
+             args={"trace_ids": [a]}),
+        _rec("serve/request", 4, _T0 + 12_000 - skew_us, dur=70_000, trace=b,
+             args={"remote_parent": 5, "attempt": 1, "request_id": 2}),
+        _rec("serve/assemble", 5, _T0 + 13_000 - skew_us, dur=5_000,
+             args={"trace_ids": [b]}),
+        _rec("serve/respond", 6, _T0 + 70_000 - skew_us, parent=4, trace=b,
+             args={"request_id": 2}),
+    ]
+    _write(tmp_path / "trace.jsonl", sup)
+    _write(tmp_path / "worker_0" / "trace.jsonl", w0)
+    _write(tmp_path / "worker_1" / "trace.jsonl", w1)
+    return tmp_path
+
+
+@pytest.mark.fast
+def test_fleet_merge_one_connected_tree_per_trace(tmp_path):
+    fleet_dir = _write_fleet_dir(tmp_path)
+    records, errors, meta = trace_report.load_fleet(
+        [fleet_dir], trace_report.load_schema())
+    assert not errors
+    assert meta["processes"] == ["trace.jsonl", "worker_0/trace.jsonl",
+                                 "worker_1/trace.jsonl"]
+    assert meta["clock_offset_us"] == {}              # shared host clock
+    summary = trace_report.summarize(records, meta)
+    fleet = summary["fleet"]
+    assert fleet["traces"] == 2
+    assert fleet["connected"] == 2                    # one root each, links ok
+    assert fleet["cross_process"] == 2
+    assert fleet["requeued"] == 1 and fleet["max_attempts"] == 2
+    assert fleet["orphan_spans"] == 1                 # w0's dead attempt
+    trees = {t["trace"]: t for t in fleet["trees"]}
+    assert trees["aaaa000000000001"]["attempts"] == 2
+    assert trees["aaaa000000000001"]["orphan_spans"] == 1
+    assert set(trees["aaaa000000000001"]["processes"]) == {
+        "trace.jsonl", "worker_0/trace.jsonl", "worker_1/trace.jsonl"}
+    assert trees["bbbb000000000002"]["orphan_spans"] == 0
+
+
+@pytest.mark.fast
+def test_fleet_merge_clock_offset_anchored_on_dispatch_assemble(tmp_path):
+    skew = 50_000
+    fleet_dir = _write_fleet_dir(tmp_path, skew_us=skew)
+    records, errors, meta = trace_report.load_fleet(
+        [fleet_dir], trace_report.load_schema())
+    assert not errors
+    # worker 1's assemble for trace B began before its dispatch — impossible
+    # causally — so its whole stream shifts forward by the violation
+    off = meta["clock_offset_us"]["worker_1/trace.jsonl"]
+    assert off >= skew - 3_000                        # recovered (±in-flight)
+    [dispatch_b] = [r for r in records if r["name"] == "fleet/dispatch"
+                    and r["args"]["trace_ids"] == ["bbbb000000000002"]]
+    [root_b] = [r for r in records
+                if r["_plabel"] == "worker_1/trace.jsonl"
+                and r["name"] == "serve/request"
+                and r.get("trace") == "bbbb000000000002"]
+    assert root_b["ts"] >= dispatch_b["ts"] - 3_000   # causal after adjust
+    assert trace_report.summarize(records, meta)["fleet"]["connected"] == 2
+
+
+@pytest.mark.fast
+def test_fleet_merge_detects_disconnected_trace(tmp_path):
+    _write_fleet_dir(tmp_path)
+    # a worker root claiming a remote parent that is NOT the trace root
+    _write(tmp_path / "worker_0" / "trace.jsonl", [
+        _rec("serve/queue_wait", 10, _T0 + 8_000, parent=9,
+             trace="aaaa000000000001", args={"request_id": 1}),
+        _rec("serve/request", 11, _T0 + 9_000, trace="cccc000000000003",
+             args={"remote_parent": 999, "attempt": 1}),
+        _rec("serve/request", 12, _T0 + 9_500, trace="cccc000000000003",
+             args={}),
+    ])
+    records, _, meta = trace_report.load_fleet(
+        [tmp_path], trace_report.load_schema())
+    fleet = trace_report.summarize(records, meta)["fleet"]
+    trees = {t["trace"]: t for t in fleet["trees"]}
+    assert trees["cccc000000000003"]["connected"] is False
+    assert fleet["connected"] == 2                    # a and b still are
+
+
+@pytest.mark.fast
+def test_fleet_chrome_export_one_track_per_process(tmp_path):
+    fleet_dir = _write_fleet_dir(tmp_path)
+    records, _, _ = trace_report.load_fleet(
+        [fleet_dir], trace_report.load_schema())
+    doc = trace_report.chrome_trace(records)
+    procs = {e["pid"]: e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert sorted(procs.values()) == ["trace.jsonl", "worker_0/trace.jsonl",
+                                      "worker_1/trace.jsonl"]
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == set(procs)    # distinct tracks
+    assert any(e["args"].get("trace") for e in spans)
+
+
+@pytest.mark.fast
+def test_trace_report_cli_on_fleet_dir(tmp_path, capsys):
+    fleet_dir = _write_fleet_dir(tmp_path, skew_us=20_000)
+    chrome = tmp_path / "chrome.json"
+    assert trace_report.main([str(fleet_dir), "--chrome", str(chrome)]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: 2 distributed trace(s)" in out
+    assert "2 connected" in out and "1 requeued" in out
+    assert "clock offset worker_1/trace.jsonl" in out
+    json.loads(chrome.read_text())                    # loadable
+    # multiple explicit paths merge too (files, not just dirs)
+    assert trace_report.main([str(tmp_path / "trace.jsonl"),
+                              str(tmp_path / "worker_1" / "trace.jsonl"),
+                              "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["fleet"]["traces"] == 2
+
+
+# ---------------------------------------------------------------------------
+# acceptance e2e: fleet trace merge + merged metrics + /debug/profile
+# (slow; CI `observability` job)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_trainer_profile_at_step_writes_artifact(tmp_path):
+    """DCR_PROFILE_AT_STEP reuses the serve armer: a tiny CPU train run with
+    it set produces a readable jax.profiler artifact under
+    <output_dir>/profile and still trains to completion."""
+    from tests.test_tracing import _run_train_cli, _tiny_train_cfg
+
+    cfg = _tiny_train_cfg(tmp_path)
+    proc, out = _run_train_cli(cfg, tmp_path / "cfg.json",
+                               extra_env={"DCR_PROFILE_AT_STEP": "2"})
+    assert proc.returncode == 0, out[-3000:]
+    assert "profile_armed" in out
+    dumped = list((Path(cfg.output_dir) / "profile").rglob("*.xplane.pb"))
+    assert dumped, f"no profiler artifact under {cfg.output_dir}/profile"
+
+@pytest.mark.slow
+def test_fleet_scope_e2e_trace_merge_metrics_profile(tmp_path, cpu_devices):
+    """dcr-scope acceptance: a 2-worker fleet with an injected worker_crash
+    serves every request; the merged /metrics carries worker-labeled series
+    and up/staleness gauges without blocking on the dead worker; a
+    POST /debug/profile round-trip yields a readable jax.profiler artifact;
+    and trace_report over the fleet dir reconstructs ONE connected span
+    tree per request — the requeued request as attempt-tagged siblings
+    under the same supervisor root."""
+    import signal
+    import subprocess
+    import sys
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dcr_tpu.core.coordination import EXIT_PREEMPTED
+    from tests._multiproc import free_port
+    from tests.test_serve import (_export_tiny_ckpt, _get, _post_generate,
+                                  _serve_env)
+
+    ckpt = _export_tiny_ckpt(tmp_path)
+    env, repo = _serve_env()
+    env["DCR_FAULTS"] = "worker_crash@batch=0&rank=0"
+    fleet_dir = tmp_path / "fleet"
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcr_tpu.cli.serve",
+         f"--model_path={ckpt}", f"--port={port}",
+         "--resolution=16", "--num_inference_steps=2", "--sampler=ddim",
+         "--max_batch=2", "--max_wait_ms=60", "--queue_depth=64",
+         "--request_timeout_s=300", "--seed=0",
+         "--fleet.workers=2", f"--fleet.dir={fleet_dir}",
+         "--fleet.heartbeat_s=0.5", "--fleet.lease_s=3",
+         "--fleet.dispatch_timeout_s=240", "--fleet.spawn_timeout_s=240",
+         "--fleet.max_attempts=6", "--fleet.respawn_max=2",
+         "--fleet.respawn_base_delay_s=2",
+         "--fleet.scrape_period_s=0.5", "--fleet.scrape_timeout_s=2"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        deadline = time.monotonic() + 300
+        while True:
+            try:
+                _, health = _get(port, "/healthz", timeout=2)
+                _, status = _get(port, "/metrics", timeout=2)
+                if health["status"] == "ok" and status["workers_alive"] == 2:
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None or time.monotonic() > deadline:
+                out = proc.stdout.read() if proc.stdout else ""
+                raise AssertionError(
+                    f"fleet did not come up (rc={proc.poll()}): {out[-4000:]}")
+            time.sleep(0.5)
+
+        # -- serve through the crash: worker 0 dies on its first batch ------
+        prompts = ["a red square", "a blue circle"] * 3
+        with ThreadPoolExecutor(max_workers=len(prompts)) as ex:
+            results = list(ex.map(
+                lambda a: _post_generate(port, a[1], seed=a[0], timeout=280),
+                enumerate(prompts)))
+        assert all(code == 200 for code, _ in results), results
+
+        # -- merged prometheus: worker-labeled series, no blocking ----------
+        time.sleep(2.0)                               # > one scrape period
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics?format=prometheus",
+                timeout=30) as resp:
+            text = resp.read().decode()
+        samples = _assert_valid_exposition(text)
+        assert 'dcr_fleet_worker_up{worker="0"}' in samples
+        assert 'dcr_fleet_worker_up{worker="1"}' in samples
+        # at least the surviving worker's full registry is merged in,
+        # worker-labeled (completed counter counts its executed requests)
+        assert float(
+            samples['dcr_serve_completed_total{worker="1"}']) >= 1.0
+        assert 'dcr_fleet_worker_scrape_age_seconds{worker="1"}' in samples
+        # fleet SLO series are first-class gauges
+        assert "dcr_fleet_availability" in samples
+        assert "dcr_fleet_queue_wait_p99_s" in samples
+        assert float(samples["dcr_fleet_requeue_rate"]) > 0.0
+
+        # -- on-demand device profiling round-trip --------------------------
+        body = json.dumps({"worker": 1, "steps": 1}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/profile", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            armed = json.loads(resp.read())
+        assert armed["worker"] == 1 and armed["armed"] is True
+
+        # drive batches until the armed capture closes and reports its path
+        artifact = None
+        for i in range(30):
+            _post_generate(port, "profile me", seed=100 + i, timeout=280)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/debug/profile",
+                    timeout=30) as resp:
+                doc = json.loads(resp.read())
+            assert doc.get("error") in (None, ""), doc
+            if doc.get("artifact"):
+                artifact = Path(doc["artifact"])
+                break
+        assert artifact is not None, "profiler capture never completed"
+        assert artifact.is_dir()
+        dumped = list(artifact.rglob("*.xplane.pb"))
+        assert dumped, f"no profiler artifact under {artifact}"
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        out = proc.stdout.read() if proc.stdout else ""
+        assert rc == EXIT_PREEMPTED, (rc, out[-4000:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    # -- fleet trace merge: one connected tree per request ------------------
+    records, errors, meta = trace_report.load_fleet(
+        [fleet_dir], trace_report.load_schema())
+    assert not errors, errors[:5]
+    assert len(meta["processes"]) >= 3                # supervisor + 2 workers
+    fleet = trace_report.summarize(records, meta)["fleet"]
+    assert fleet is not None
+    assert fleet["traces"] == fleet["connected"], fleet
+    assert fleet["cross_process"] == fleet["traces"], fleet
+    # the crashed batch's requests were requeued: same trace id, attempt 2+
+    assert fleet["requeued"] >= 1 and fleet["max_attempts"] >= 2, fleet
+    # worker-side roots really join the supervisor's tree (not fresh roots)
+    worker_roots = [r for r in records
+                    if r["name"] == "serve/request"
+                    and r["args"].get("remote_parent") is not None]
+    assert worker_roots
+    # and the report CLI ships it end to end
+    import subprocess as sp
+    import sys as _sys
+    env2, repo2 = _serve_env()
+    chrome = tmp_path / "fleet_chrome.json"
+    rep = sp.run([_sys.executable, "-m", "tools.trace_report",
+                  str(fleet_dir), "--chrome", str(chrome)],
+                 env=env2, cwd=repo2, capture_output=True, text=True,
+                 timeout=60)
+    assert rep.returncode == 0, rep.stdout + rep.stderr
+    assert "fleet:" in rep.stdout and "requeued" in rep.stdout
+    procs = {e["args"]["name"]
+             for e in json.loads(chrome.read_text())["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert len(procs) >= 3                            # one track per process

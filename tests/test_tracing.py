@@ -99,8 +99,9 @@ def test_prometheus_text_renders_and_parses():
     for line in text.strip().splitlines():
         if line.startswith("#"):
             parts = line.split()
-            assert parts[1] == "TYPE" and parts[3] in ("counter", "gauge",
-                                                       "summary")
+            assert parts[1] in ("TYPE", "HELP")
+            if parts[1] == "TYPE":
+                assert parts[3] in ("counter", "gauge", "summary")
             continue
         name, value = line.rsplit(" ", 1)
         samples[name] = float(value)

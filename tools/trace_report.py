@@ -1,22 +1,36 @@
-"""trace_report: turn a run directory's trace.jsonl into answers.
+"""trace_report: turn trace.jsonl files — one process or a whole fleet —
+into answers.
 
-    python -m tools.trace_report <run_dir> [--chrome out.json] [--json]
+    python -m tools.trace_report <path> [<path> ...] [--chrome out.json]
+                                 [--json]
 
-Reads every ``trace*.jsonl`` the run's processes wrote (core/tracing.py),
-validates each record against the checked-in ``tools/trace_schema.json``,
-and prints the report a perf investigation starts from:
+Each path is a directory (searched RECURSIVELY for ``trace*.jsonl``, so a
+fleet dir whose supervisor writes ``trace.jsonl`` and whose workers write
+``worker_<i>/trace.jsonl`` merges in one invocation) or a single trace
+file. Size-capped rotation segments (``trace.jsonl.1..N``, core/tracing.py)
+are read oldest-first as part of their base file's stream. Every record is
+validated against the checked-in ``tools/trace_schema.json``. The report:
 
 - stage-time breakdown: wall time per span name and per category
   (data vs step vs ckpt vs eval vs serve), with p50/p99 per name;
 - serve queue-wait percentiles (the ``serve/queue_wait`` spans) and
   recompile count per bucket (``serve/compile`` events);
 - fault timeline: every ``fault/*`` event in chronological order, plus any
-  flight-recorder dumps present in the directory.
+  flight-recorder dumps present in the directory;
+- fleet section (when spans carry distributed trace ids): per-file clock
+  offsets anchored on supervisor ``fleet/dispatch`` ↔ worker
+  ``serve/assemble`` pairs (a dispatch causally precedes its assemble, so a
+  worker file whose assemble timestamps land before their dispatch is
+  shifted forward by the largest violation — zero on one host), then one
+  span tree per trace id across processes: connectivity, cross-process
+  reach, requeue attempts, and partial spans left by attempts that died
+  mid-flight.
 
 ``--chrome`` additionally writes a Chrome-trace JSON (``traceEvents`` array)
-loadable in Perfetto / chrome://tracing. Exit codes: 0 = report produced,
-1 = no trace records found, 2 = schema violations (the trace is corrupt or
-a writer drifted from the schema — CI fails on this).
+loadable in Perfetto / chrome://tracing, one track (pid) per source
+process. Exit codes: 0 = report produced, 1 = no trace records found,
+2 = schema violations (the trace is corrupt or a writer drifted from the
+schema — CI fails on this).
 
 Pure stdlib on purpose (like tools/lint): runs on a bare checkout.
 """
@@ -72,25 +86,125 @@ def validate_record(rec: dict, schema: dict) -> list[str]:
     return problems
 
 
-def load_trace(run_dir: Path, schema: dict) -> tuple[list[dict], list[str]]:
-    """(records, errors) across every trace*.jsonl under run_dir (all ranks)."""
+def _rotation_index(path: Path) -> int:
+    """0 for a base ``trace*.jsonl``, N for a rotated ``trace*.jsonl.N``."""
+    suffix = path.name.rpartition(".jsonl")[2]
+    return int(suffix[1:]) if suffix.startswith(".") else 0
+
+
+def discover_streams(paths: list[Path]) -> list[tuple[str, list[Path]]]:
+    """[(label, [files oldest-first])] — one stream per writing process.
+
+    A stream is a base ``trace*.jsonl`` plus its size-rotation segments
+    (``.1`` newest rotated … ``.N`` oldest), read oldest-first so records
+    stay time-ordered per process. Directories are searched recursively
+    (a fleet dir nests worker traces in ``worker_<i>/``); labels are the
+    base file's path relative to the argument that found it."""
+    streams: list[tuple[str, list[Path]]] = []
+    seen: set[Path] = set()
+    labels: set[str] = set()
+    for arg in paths:
+        bases = ([arg] if arg.is_file() else
+                 sorted(p for p in arg.rglob("trace*.jsonl") if p.is_file()))
+        for base in bases:
+            base = base.resolve()
+            if base in seen:
+                continue
+            seen.add(base)
+            segments = sorted(
+                (p for p in base.parent.glob(base.name + ".*")
+                 if p.name[len(base.name) + 1:].isdigit()),
+                key=_rotation_index, reverse=True)
+            try:
+                label = str(base.relative_to(arg.resolve())) \
+                    if arg.is_dir() else str(arg)
+            except ValueError:
+                label = str(base)
+            if label in labels:
+                # two args with identical relative layouts (two fleet dirs):
+                # labels must stay 1:1 with streams — clock offsets, per-tree
+                # process sets and Chrome tracks all key on them
+                label = f"{arg}:{label}"
+            while label in labels:
+                label += "'"
+            labels.add(label)
+            streams.append((label, segments + [base]))
+    return streams
+
+
+def _anchor_offsets(records: list[dict],
+                    labels: list[str]) -> dict[str, int]:
+    """Per-stream clock offset (microseconds to ADD) from dispatch↔assemble
+    causality: a supervisor's ``fleet/dispatch`` span for a batch begins
+    before any member's ``serve/assemble`` on the worker. A stream whose
+    assemble starts earlier than its anchoring dispatch has a clock behind
+    the supervisor's; shift it forward by the largest violation. Streams
+    sharing a host clock (the common fleet-on-one-host case) get 0."""
+    dispatches = [r for r in records
+                  if r["ph"] == "X" and r["name"] == "fleet/dispatch"]
+    if not dispatches:
+        return {lab: 0 for lab in labels}
+    by_trace: dict[str, int] = {}          # trace id -> earliest dispatch ts
+    for d in dispatches:
+        for t in d["args"].get("trace_ids") or []:
+            if t is not None:
+                by_trace[t] = min(by_trace.get(t, d["ts"]), d["ts"])
+    offsets = {lab: 0 for lab in labels}
+    for r in records:
+        if r["ph"] != "X" or r["name"] != "serve/assemble":
+            continue
+        anchors = [by_trace[t] for t in (r["args"].get("trace_ids") or [])
+                   if t in by_trace]
+        if anchors:
+            violation = min(anchors) - r["ts"]
+            offsets[r["_plabel"]] = max(offsets[r["_plabel"]], violation)
+    return offsets
+
+
+def load_fleet(paths: list[Path],
+               schema: dict) -> tuple[list[dict], list[str], dict]:
+    """(records, errors, meta) across every stream under ``paths``.
+
+    Each record gains ``_proc`` (stream index — the Chrome-export pid, since
+    fleet processes are all jax rank 0) and ``_plabel`` (stream label);
+    timestamps are clock-offset-adjusted per stream (see
+    :func:`_anchor_offsets`). ``meta`` carries the stream labels and the
+    applied offsets."""
     records: list[dict] = []
     errors: list[str] = []
-    for path in sorted(run_dir.glob("trace*.jsonl")):
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            if not line.strip():
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError as e:
-                errors.append(f"{path.name}:{lineno}: not JSON ({e})")
-                continue
-            problems = validate_record(rec, schema)
-            if problems:
-                errors.append(f"{path.name}:{lineno}: " + "; ".join(problems))
-                continue
-            records.append(rec)
+    streams = discover_streams(paths)
+    for proc, (label, files) in enumerate(streams):
+        for path in files:
+            for lineno, line in enumerate(path.read_text().splitlines(), 1):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError as e:
+                    errors.append(f"{path.name}:{lineno}: not JSON ({e})")
+                    continue
+                problems = validate_record(rec, schema)
+                if problems:
+                    errors.append(f"{path.name}:{lineno}: "
+                                  + "; ".join(problems))
+                    continue
+                rec["_proc"] = proc
+                rec["_plabel"] = label
+                records.append(rec)
+    labels = [label for label, _ in streams]
+    offsets = _anchor_offsets(records, labels)
+    for rec in records:
+        rec["ts"] += offsets[rec["_plabel"]]
     records.sort(key=lambda r: r["ts"])
+    meta = {"processes": labels,
+            "clock_offset_us": {k: v for k, v in offsets.items() if v}}
+    return records, errors, meta
+
+
+def load_trace(run_dir: Path, schema: dict) -> tuple[list[dict], list[str]]:
+    """(records, errors) across every trace*.jsonl under run_dir (all ranks,
+    rotated segments included). Compatibility wrapper over load_fleet."""
+    records, errors, _ = load_fleet([run_dir], schema)
     return records, errors
 
 
@@ -126,7 +240,96 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[int(idx)]
 
 
-def summarize(records: list[dict]) -> dict:
+def assemble_trace_trees(records: list[dict]) -> list[dict]:
+    """One document per distributed trace id: the cross-process span tree.
+
+    Span ids are process-local (core/tracing.py counts from 1 in every
+    process), so tree edges resolve per stream: a span's ``parent`` points
+    within its own file, while a worker's ``serve/request`` root crosses
+    streams via ``args.remote_parent`` — the supervisor root span id shipped
+    in the wire context. A trace is **connected** when exactly one global
+    root exists and every remote_parent reference names it. Spans whose
+    parent was never written (an attempt SIGKILLed mid-batch emits children
+    before its root ends) are counted as ``orphan_spans`` — expected debris
+    of a crashed attempt, attributed to the trace by id but outside the
+    tree."""
+    spans = [r for r in records if r["ph"] == "X" and r.get("trace")]
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    trees = []
+    for trace_id, group in sorted(by_trace.items()):
+        roots = [s for s in group if s["parent"] is None
+                 and s["args"].get("remote_parent") is None]
+        remote_roots = [s for s in group
+                        if s["args"].get("remote_parent") is not None]
+        root = roots[0] if len(roots) == 1 else None
+        links_ok = root is not None and all(
+            r["args"]["remote_parent"] == root["id"]
+            and r["_proc"] != root["_proc"] for r in remote_roots)
+        # reachability: same-process parent edges + the remote hops
+        anchored_keys: set[tuple[int, int]] = set()
+        if root is not None:
+            frontier = [root] + (remote_roots if links_ok else [])
+            anchored_keys = {(s["_proc"], s["id"]) for s in frontier}
+            grew = True
+            while grew:
+                grew = False
+                for s in group:
+                    key = (s["_proc"], s["id"])
+                    if key in anchored_keys or s["parent"] is None:
+                        continue
+                    if (s["_proc"], s["parent"]) in anchored_keys:
+                        anchored_keys.add(key)
+                        grew = True
+        anchored = len(anchored_keys)
+        attempts = [int(s["args"].get("attempt", 1)) for s in remote_roots]
+        trees.append({
+            "trace": trace_id,
+            "spans": len(group),
+            "processes": sorted({s["_plabel"] for s in group}),
+            "roots": len(roots),
+            "connected": len(roots) == 1 and links_ok,
+            "anchored_spans": anchored,
+            "orphan_spans": len(group) - anchored,
+            "attempts": max(attempts) if attempts else 1,
+            "names": sorted({s["name"] for s in group}),
+        })
+    return trees
+
+
+# per-trace tree documents kept in the summary/--json output. Aggregates
+# cover everything; the individual docs are for drill-down, and a long
+# single-process serve run (every request carries a trace id) would
+# otherwise embed one doc per lifetime request.
+_MAX_TREES = 50
+
+
+def fleet_summary(records: list[dict], meta: dict) -> dict | None:
+    """The distributed-trace section of the report (None when nothing
+    carries a trace id — e.g. train/eval runs keep their old report shape).
+    Aggregate counts cover every trace; ``trees`` lists the interesting ones
+    first (disconnected, then requeued) capped at ``_MAX_TREES`` with the
+    overflow counted in ``trees_truncated``."""
+    trees = assemble_trace_trees(records)
+    if not trees:
+        return None
+    shown = sorted(trees, key=lambda t: (t["connected"], -t["attempts"]))
+    return {
+        "processes": meta.get("processes", []),
+        "clock_offset_us": meta.get("clock_offset_us", {}),
+        "traces": len(trees),
+        "connected": sum(t["connected"] for t in trees),
+        "cross_process": sum(len(t["processes"]) > 1 for t in trees),
+        "requeued": sum(t["attempts"] > 1 for t in trees),
+        "max_attempts": max(t["attempts"] for t in trees),
+        "orphan_spans": sum(t["orphan_spans"] for t in trees),
+        "trees": shown[:_MAX_TREES],
+        "trees_truncated": max(0, len(trees) - _MAX_TREES),
+    }
+
+
+def summarize(records: list[dict], meta: dict | None = None) -> dict:
     """The report document (also the --json output)."""
     spans = [r for r in records if r["ph"] == "X"]
     events = [r for r in records if r["ph"] == "i"]
@@ -185,24 +388,35 @@ def summarize(records: list[dict]) -> dict:
         "serve_queue_wait": queue_wait,
         "serve_recompiles_per_bucket": recompiles,
         "fault_timeline": faults,
+        "fleet": fleet_summary(records, meta or {}),
     }
 
 
 def chrome_trace(records: list[dict]) -> dict:
     """Chrome-trace/Perfetto document: spans -> complete ('X') events, instants
-    -> 'i' events with thread scope, plus thread_name metadata so Perfetto
-    labels rows with real thread names instead of idents."""
+    -> 'i' events with thread scope, plus process_name/thread_name metadata —
+    one track (pid) per SOURCE PROCESS (stream), since fleet supervisor and
+    workers are all jax rank 0 and would otherwise collapse onto one row."""
     out = []
+    seen_procs = set()
     seen_threads = set()
     for r in records:
-        key = (r["pid"], r["tid"])
+        pid = r.get("_proc", r["pid"])
+        if pid not in seen_procs:
+            seen_procs.add(pid)
+            out.append({"ph": "M", "name": "process_name", "pid": pid,
+                        "tid": 0,
+                        "args": {"name": r.get("_plabel", f"rank {r['pid']}")}})
+        key = (pid, r["tid"])
         if key not in seen_threads:
             seen_threads.add(key)
-            out.append({"ph": "M", "name": "thread_name", "pid": r["pid"],
+            out.append({"ph": "M", "name": "thread_name", "pid": pid,
                         "tid": r["tid"], "args": {"name": r["tname"]}})
         ev = {"ph": r["ph"], "name": r["name"], "ts": r["ts"],
-              "pid": r["pid"], "tid": r["tid"], "cat": category_of(r["name"]),
-              "args": dict(r["args"], id=r["id"], parent=r.get("parent"))}
+              "pid": pid, "tid": r["tid"], "cat": category_of(r["name"]),
+              "args": dict(r["args"], id=r["id"], parent=r.get("parent"),
+                           **({"trace": r["trace"]} if r.get("trace")
+                              else {}))}
         if r["ph"] == "X":
             ev["dur"] = r["dur"]
         else:
@@ -215,10 +429,28 @@ def chrome_trace(records: list[dict]) -> dict:
 # rendering
 # ---------------------------------------------------------------------------
 
-def render_text(summary: dict, run_dir: Path) -> str:
-    lines = [f"trace report: {run_dir}",
+def render_text(summary: dict, paths: list[Path] | Path) -> str:
+    paths = [paths] if isinstance(paths, Path) else list(paths)
+    lines = [f"trace report: {', '.join(map(str, paths))}",
              f"  {summary['spans']} spans / {summary['events']} events "
              f"from ranks {summary['ranks']} over {summary['wall_span_s']}s"]
+    fleet = summary.get("fleet")
+    if fleet:
+        lines.append(
+            f"\nfleet: {fleet['traces']} distributed trace(s) across "
+            f"{len(fleet['processes'])} process file(s) — "
+            f"{fleet['connected']} connected, "
+            f"{fleet['cross_process']} cross-process, "
+            f"{fleet['requeued']} requeued (max attempt "
+            f"{fleet['max_attempts']}), "
+            f"{fleet['orphan_spans']} orphan span(s) from dead attempts")
+        for lab, off in sorted(fleet["clock_offset_us"].items()):
+            lines.append(f"  clock offset {lab}: +{off} us "
+                         "(anchored on dispatch<->assemble)")
+        broken = [t for t in fleet["trees"] if not t["connected"]]
+        for t in broken[:10]:
+            lines.append(f"  DISCONNECTED trace {t['trace']}: "
+                         f"{t['roots']} root(s), spans {t['names']}")
     lines.append("\nstage-time breakdown (host wall time per category):")
     total = sum(c["total_ms"] for c in summary["categories"].values()) or 1.0
     for cat, row in sorted(summary["categories"].items(),
@@ -246,7 +478,8 @@ def render_text(summary: dict, run_dir: Path) -> str:
             lines.append(f"  {f['time']} r{f['rank']} {f['name']} {f['args']}")
     else:
         lines.append("\nfault timeline: clean (no fault/* events)")
-    flightrecs = sorted(run_dir.glob("flightrec_*.json"))
+    flightrecs = sorted({p for d in paths if d.is_dir()
+                         for p in d.rglob("flightrec_*.json")})
     if flightrecs:
         lines.append("flight-recorder dumps:")
         for p in flightrecs:
@@ -261,37 +494,43 @@ def render_text(summary: dict, run_dir: Path) -> str:
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.trace_report",
-        description="Stage-time breakdown + fault timeline from a run's "
-                    "trace.jsonl; optional Chrome-trace export.")
-    ap.add_argument("run_dir", type=Path,
-                    help="directory holding trace*.jsonl (a run's output_dir "
-                         "or a serve --logdir)")
+        description="Stage-time breakdown + fault timeline + fleet trace "
+                    "merge from trace.jsonl files; optional Chrome-trace "
+                    "export.")
+    ap.add_argument("paths", type=Path, nargs="+", metavar="PATH",
+                    help="directories searched recursively for trace*.jsonl "
+                         "(a run's output_dir, a serve --logdir, or a fleet "
+                         "dir) and/or individual trace files")
     ap.add_argument("--chrome", type=Path, default=None, metavar="OUT.json",
-                    help="also write a Chrome-trace/Perfetto JSON export")
+                    help="also write a Chrome-trace/Perfetto JSON export "
+                         "(one track per source process)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
     args = ap.parse_args(argv)
 
-    if not args.run_dir.is_dir():
-        print(f"trace_report: {args.run_dir} is not a directory", file=sys.stderr)
-        return 1
+    for p in args.paths:
+        if not p.is_dir() and not p.is_file():
+            print(f"trace_report: {p} is not a directory or file",
+                  file=sys.stderr)
+            return 1
     schema = load_schema()
-    records, errors = load_trace(args.run_dir, schema)
+    records, errors, meta = load_fleet(args.paths, schema)
     if errors:
         for e in errors[:20]:
             print(f"trace_report: SCHEMA: {e}", file=sys.stderr)
         print(f"trace_report: {len(errors)} invalid record(s)", file=sys.stderr)
         return 2
     if not records:
-        print(f"trace_report: no trace records under {args.run_dir} "
+        print(f"trace_report: no trace records under "
+              f"{', '.join(map(str, args.paths))} "
               "(no trace*.jsonl, or all files empty)", file=sys.stderr)
         return 1
-    summary = summarize(records)
+    summary = summarize(records, meta)
     if args.chrome:
         args.chrome.write_text(json.dumps(chrome_trace(records)))
         print(f"trace_report: wrote chrome trace -> {args.chrome}", file=sys.stderr)
     print(json.dumps(summary, indent=1) if args.json
-          else render_text(summary, args.run_dir))
+          else render_text(summary, args.paths))
     return 0
 
 
