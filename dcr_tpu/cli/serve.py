@@ -170,6 +170,10 @@ def _run_worker(cfg: ServeConfig) -> None:
             resolution=cfg.resolution, mesh=cfg.mesh))
     writer = MetricWriter(logdir, use_tensorboard=False) if logdir else None
     service = GenerationService(cfg, stack, writer=writer)
+    # warming state flips BEFORE the port opens: /healthz must never say
+    # "ok" while the warm plan (previous incarnation's bucket set + the
+    # default bucket) is still compiling / cache-loading
+    planned = service.begin_warm()
     service.start()
 
     httpd = make_server(cfg, service)
@@ -178,27 +182,48 @@ def _run_worker(cfg: ServeConfig) -> None:
     server_thread.start()
     port = httpd.server_address[1]
     log.info("dcr-serve listening on http://%s:%d (model %s, default bucket "
-             "%s, max_batch=%d, max_wait=%.0fms, queue_depth=%d)",
+             "%s, max_batch=%d, max_wait=%.0fms, queue_depth=%d, "
+             "warm plan=%d bucket(s)%s)",
              cfg.host, port, cfg.model_path, service.default_bucket(),
-             cfg.max_batch, cfg.max_wait_ms, cfg.queue_depth)
+             cfg.max_batch, cfg.max_wait_ms, cfg.queue_depth, planned,
+             f", cache {cfg.warm.dir}" if cfg.warm.dir else "")
 
     heartbeat = None
+    lease = None
     if index >= 0:
         from dcr_tpu.serve.fleet import (LeaseHeartbeat, WorkerLease,
-                                         fleet_paths)
+                                         fleet_paths, write_lease)
 
-        # join the fleet only now: a published lease means "dispatchable" —
-        # the stack is loaded and the real port (bound as 0) is known
+        # publish the lease EARLY with ready=False: the supervisor sees a
+        # live, warming worker (and spawn_timeout_s covers load + warm
+        # start), but attaches no dispatch channel until ready flips — it
+        # never dispatches into a cold worker
         paths = fleet_paths(cfg.fleet.dir).ensure()
         lease = WorkerLease(
             index=index, pid=os.getpid(), port=port,
             vae_scale=vae_scale_factor(stack.models.vae.config),
-            lease_s=cfg.fleet.lease_s)
+            lease_s=cfg.fleet.lease_s,
+            ready=False, buckets_warm=0, buckets_total=planned)
         heartbeat = LeaseHeartbeat(paths, lease,
                                    cfg.fleet.heartbeat_s).start()
-        log.info("fleet worker %d joined: lease %s (heartbeat %.1fs, "
+        log.info("fleet worker %d warming: lease %s (heartbeat %.1fs, "
                  "lease %.1fs)", index, paths.lease_file(index),
                  cfg.fleet.heartbeat_s, cfg.fleet.lease_s)
+
+    with R.stage("serve_warm"):
+        warm = service.warm_start()
+    if heartbeat is not None:
+        # readiness rides the lease payload: flip + republish immediately
+        # (the heartbeat keeps renewing the ready lease from here; counts
+        # are written before `ready` so a racing heartbeat can publish a
+        # stale-but-warming lease, never a ready-with-stale-counts one)
+        lease.buckets_warm = warm["buckets_warm"]
+        lease.buckets_total = warm["buckets_total"]
+        lease.ready = True
+        write_lease(paths, lease)
+        log.info("fleet worker %d ready: %d/%d bucket(s) warm in %.2fs",
+                 index, warm["buckets_warm"], warm["buckets_total"],
+                 warm["seconds"])
 
     drained = threading.Event()
     R.install_signal_drain(lambda signum: drained.set())

@@ -27,6 +27,12 @@ def main(argv=None) -> None:
     reg = faults.registry()
     if reg:
         log.warning("fault injection ACTIVE (DCR_FAULTS): %s", reg.pending())
+    if cfg.warm.dir:
+        # dcr-warm: after restore, the Trainer pre-populates the train-step
+        # and params-finite programs from this persistent executable cache —
+        # a preempted pod's first step is a cache load, not an XLA recompile
+        log.info("warm cache enabled: %s (train step pre-populated after "
+                 "restore)", cfg.warm.dir)
     # periodic sample grids every save_steps (the reference's visual check)
     trainer = Trainer(cfg, sample_hook=make_sample_hook())
     trainer.install_preemption_handler()

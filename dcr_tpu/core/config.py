@@ -230,6 +230,29 @@ class FaultToleranceConfig:
 
 
 @dataclass
+class WarmCacheConfig:
+    """Persistent executable cache + warm-start readiness (core/warmcache.py).
+
+    With ``dir`` set, every AOT-lowered program (train step, params-finite
+    check, serve buckets, serve text encoder, bulk samplers, eval extractor)
+    is served from a fingerprint-keyed on-disk executable cache: a respawned
+    worker or resumed trainer loads compiled code instead of paying XLA
+    again. The fingerprint covers avals/shardings/donation/static
+    config/lowered HLO plus topology and jax/jaxlib versions, so a stale or
+    mismatched entry is detected — and quarantined — never loaded blind.
+    ``dir`` may be shared by a whole fleet (atomic last-writer-wins entries).
+    """
+
+    dir: str = ""             # "" = no persistence (AOT warm start still runs
+    #                           where a readiness phase exists, e.g. serve)
+    # serve only: precompile the warm-manifest bucket set (plus the default
+    # bucket) before reporting ready / publishing a ready lease. Off = the
+    # pre-dcr-warm behavior (lazy compile on first use; /healthz never
+    # reports "warming").
+    warm_start: bool = True
+
+
+@dataclass
 class OptimConfig:
     learning_rate: float = 5e-6
     adam_beta1: float = 0.9
@@ -276,6 +299,7 @@ class TrainConfig:
     optim: OptimConfig = field(default_factory=OptimConfig)
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
+    warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
 
 
 @dataclass
@@ -297,6 +321,7 @@ class SampleConfig:
     rand_augs: str = "none"                # INFERENCE_AUGS
     rand_aug_repeats: int = 2              # reference diff_inference.py:218
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
 
 
 @dataclass
@@ -386,6 +411,7 @@ class ServeConfig:
     seed: int = 42                         # folds into per-request keys
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
 
 
 def validate_serve_config(cfg: ServeConfig) -> None:
@@ -463,6 +489,7 @@ class EvalConfig:
     seed: int = 42
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
+    warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
 
 
 @dataclass

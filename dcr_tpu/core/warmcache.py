@@ -1,0 +1,642 @@
+"""dcr-warm: AOT lowering + a persistent on-disk executable cache.
+
+Compiled programs currently build lazily per process and evaporate on
+restart — the worst possible behavior for preemptible pods (ROADMAP item 3):
+a respawned serve worker pays full XLA recompilation of its bucket set
+before it can answer a single request, and a preempted trainer re-lowers the
+train step before resuming. This module makes cold start a cache load:
+
+- **AOT compile** (:func:`aot_compile`): every ``@compile_surface``-
+  registered jit program is lowered ahead of time
+  (``jit_fn.lower(*avals)``) and compiled eagerly, so readiness ("this
+  process can serve") is a fact, not a hope that the first request compiles.
+- **Persistent cache** (:class:`WarmCache`): the compiled executable is
+  serialized (``jax.experimental.serialize_executable`` — probed at runtime;
+  environments where raw executable deserialization is version-fragile fall
+  back to a ``jax.export`` lowered-StableHLO + compile-on-load tier, and
+  every executable-tier payload is VALIDATED by an immediate deserialize
+  before it is persisted, degrading per-entry to the export tier — this
+  jaxlib's CPU backend emits unserializable executables when XLA served the
+  compile from its own disk cache) into a single self-verifying entry file.
+  Entries are keyed on the same
+  fingerprint machinery as ``compile_manifest.json`` (tools/check/manifest
+  delegates its aval description here): input/output avals (incl.
+  shardings), donation, static config, the lowered-HLO digest, **plus**
+  topology (platform/device kind/device and process counts) and the
+  jax/jaxlib versions — so a stale, version-skewed, or wrong-topology entry
+  is *detected by key*, never loaded blind.
+- **Robustness is engineered, not assumed**: a corrupt, truncated,
+  bit-flipped, or fingerprint-mismatched entry degrades to a normal
+  recompile with a ``warmcache/*`` fault counter and a quarantine rename
+  (the same retry/quarantine discipline as :mod:`dcr_tpu.core.resilience`);
+  the ``cache_corrupt`` fault kind (utils/faults.py) drives that path
+  deterministically in CI. Concurrent writers — N fleet workers sharing one
+  cache directory — use write-to-temp + atomic rename, last writer wins;
+  readers can never observe a torn entry.
+- **Warm-start manifest** (:func:`read_warm_manifest` /
+  :func:`update_warm_manifest`): the bucket set a serve incarnation compiled,
+  persisted so the *next* incarnation precompiles it before admitting
+  traffic (serve/worker.py's warm-start readiness phase).
+
+Entry file layout (single file => atomic replace is the whole concurrency
+story)::
+
+    MAGIC | u32 meta length | meta JSON | payload bytes
+
+where meta records the full fingerprint, the payload sha256 and length, and
+the serialization tier. Every check failure names its kind:
+``warmcache/cache_truncated`` (short read / bad lengths),
+``warmcache/cache_corrupt`` (magic/JSON/sha damage),
+``warmcache/fingerprint_mismatch`` (an entry that is not the program we
+asked for), ``warmcache/load_error`` (deserialization failed — version
+skew inside a same-key entry).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import struct
+import threading
+import time
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Callable, Optional
+
+import logging
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+
+log = logging.getLogger("dcr_tpu")
+
+CACHE_VERSION = 1
+MAGIC = b"DCRWC1\n"
+_LEN = struct.Struct(">I")
+
+# serialization tiers, probed at runtime (see active_tier)
+TIER_EXECUTABLE = "executable"   # jax.experimental.serialize_executable
+TIER_EXPORT = "export"           # jax.export StableHLO, compile-on-load
+
+#: trees up to this many leaves keep per-leaf detail in describe_avals
+DETAIL_LEAVES = 24
+
+
+def _sha(data: bytes | str) -> str:
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    return hashlib.sha256(data).hexdigest()
+
+
+def _quarantine_rename(path: Path) -> Optional[Path]:
+    """Rename a bad file out of its addressable name
+    (``<name>.quarantined.<pid>.<ts>``); None when the rename itself fails
+    (racing quarantiners / an entry already rewritten) — callers still log
+    and count the degraded load either way."""
+    dest = path.with_name(
+        f"{path.name}.quarantined.{os.getpid()}.{int(time.time())}")
+    try:
+        os.replace(path, dest)
+    except OSError as e:
+        R.log_event("warmcache_quarantine_rename_failed", path=str(path),
+                    error=repr(e))
+        return None
+    return dest
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints (the compile_manifest.json machinery lives here; tools/check/
+# manifest.py delegates so cache keys and manifest entries can never drift)
+# ---------------------------------------------------------------------------
+
+def describe_avals(tree: Any) -> dict:
+    """Digestible description of a pytree of avals/arrays: per-leaf
+    path/dtype/shape/sharding lines, sorted, plus a digest over them."""
+    import jax
+
+    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
+    lines = []
+    for path, leaf in leaves_with_path:
+        keystr = jax.tree_util.keystr(path) or "."
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        shape = tuple(getattr(leaf, "shape", ()))
+        sharding = getattr(leaf, "sharding", None)
+        desc = f"{keystr}: {dtype}{list(shape)}"
+        if sharding is not None:
+            desc += f" @ {sharding}"
+        lines.append(desc)
+    lines.sort()
+    out = {"leaves": len(lines), "digest": _sha("\n".join(lines))[:16]}
+    out["detail"] = lines if len(lines) <= DETAIL_LEAVES \
+        else lines[:4] + [f"... ({len(lines) - 4} more leaves)"]
+    return out
+
+
+def abstract_args(args: tuple) -> tuple:
+    """Live call arguments -> lowering avals. Device arrays keep their
+    sharding (an executable compiled for the wrong layout must be a
+    different cache key); numpy/scalars become plain ShapeDtypeStructs;
+    ShapeDtypeStructs pass through."""
+    import jax
+    import numpy as np
+
+    def conv(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return x
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        arr = np.asarray(x)
+        return jax.ShapeDtypeStruct(arr.shape, arr.dtype)
+
+    return tuple(jax.tree.map(conv, a) for a in args)
+
+
+def topology_fingerprint() -> dict:
+    """The placement facts an executable is only valid under."""
+    import jax
+    import jaxlib
+
+    devices = jax.devices()
+    return {
+        "platform": jax.default_backend(),
+        "device_kind": devices[0].device_kind if devices else "none",
+        "device_count": len(devices),
+        "process_count": jax.process_count(),
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+    }
+
+
+def program_fingerprint(surface: str, lowered, avals: tuple, *,
+                        static_config: Optional[dict] = None) -> dict:
+    """One dict that fully identifies a compiled program: surface name,
+    static knobs, aval digests (in incl. sharding / out), donation, the
+    lowered-HLO digest, topology and toolchain versions. Equal fingerprint
+    <=> the cached executable is byte-for-byte the program we would compile
+    now. The serialization TIER is deliberately NOT part of the key: it
+    lives in the entry meta, and the loader can deserialize either tier —
+    so a per-entry degrade to the export tier stays findable."""
+    text = lowered.as_text()
+    out_info = getattr(lowered, "out_info", None)
+    fp = {
+        "version": CACHE_VERSION,
+        "surface": surface,
+        "static_config": dict(sorted((static_config or {}).items())),
+        "in_avals": describe_avals(avals)["digest"],
+        "out_avals": (describe_avals(out_info)["digest"]
+                      if out_info is not None else ""),
+        "donated_inputs": text.count("tf.aliasing_output"),
+        "lowered_sha256": _sha(text),
+        "topology": topology_fingerprint(),
+    }
+    # canonicalize through one JSON round-trip: the in-memory fingerprint
+    # must be byte-equal to what an entry's meta deserializes to, or a
+    # JSON-lossy static_config value (tuple -> list, enum -> str) would make
+    # every boot quarantine the good entry it just wrote
+    return json.loads(json.dumps(fp, sort_keys=True, default=str))
+
+
+def entry_key(fingerprint: dict) -> str:
+    """Stable content key for an entry file name."""
+    return _sha(json.dumps(fingerprint, sort_keys=True, default=str))[:32]
+
+
+# ---------------------------------------------------------------------------
+# Serialization tiers
+# ---------------------------------------------------------------------------
+
+_tier_lock = threading.Lock()
+_probed_tier: Optional[str] = None
+_warned_bad_tier_env = False
+
+
+def active_tier() -> str:
+    """The serialization tier this process uses for new entries.
+
+    ``DCR_WARMCACHE_TIER`` forces one; otherwise a one-time probe serializes
+    and reloads a trivial executable — jaxlibs where raw executable
+    deserialization does not survive fall back to the ``jax.export``
+    lowered-StableHLO tier (compile-on-load: slower than an executable load,
+    still version-portable and far better than relowering from Python)."""
+    global _probed_tier, _warned_bad_tier_env
+    env = os.environ.get("DCR_WARMCACHE_TIER", "")
+    if env in (TIER_EXECUTABLE, TIER_EXPORT):
+        return env
+    if env and not _warned_bad_tier_env:
+        # a typo'd override silently probing instead would persist entries
+        # at exactly the tier the operator tried to avoid — be loud once
+        _warned_bad_tier_env = True
+        R.log_event("warmcache_bad_tier_env", value=env,
+                    expected=[TIER_EXECUTABLE, TIER_EXPORT])
+        R.bump_counter("warmcache/bad_tier_env")
+    with _tier_lock:
+        if _probed_tier is None:
+            _probed_tier = _probe_tier()
+        return _probed_tier
+
+
+def _probe_tier() -> str:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    try:
+        from jax.experimental import serialize_executable as se
+
+        fn = jax.jit(lambda x: x + 1)
+        comp = fn.lower(jax.ShapeDtypeStruct((2,), jnp.float32)).compile()
+        loaded = se.deserialize_and_load(*se.serialize(comp))
+        np.asarray(loaded(np.zeros((2,), np.float32)))
+        return TIER_EXECUTABLE
+    except Exception as e:
+        R.log_event("warmcache_probe_failed", error=repr(e),
+                    fallback=TIER_EXPORT)
+        R.bump_counter("warmcache/probe_failed")
+        return TIER_EXPORT
+
+
+def _serialize_payload(tier: str, jit_fn, avals: tuple, compiled) -> bytes:
+    if tier == TIER_EXECUTABLE:
+        from jax.experimental import serialize_executable as se
+
+        return pickle.dumps(se.serialize(compiled), protocol=4)
+    from jax import export as jexport
+
+    return bytes(jexport.export(jit_fn)(*avals).serialize())
+
+
+def build_payload(tier: str, jit_fn, avals: tuple, compiled) -> bytes:
+    """Serialize AND validate. The executable tier is validated by an
+    immediate in-process deserialize: this jaxlib's CPU backend can emit
+    executables whose serialized form is missing their jit-compiled symbol
+    library (observed when XLA served the compile from its own persistent
+    cache — ``Symbols not found`` on load), and a payload that cannot
+    deserialize must never be persisted. The export tier is StableHLO and
+    validates by construction (a compile-on-load validation would cost a
+    full compile)."""
+    payload = _serialize_payload(tier, jit_fn, avals, compiled)
+    if tier == TIER_EXECUTABLE:
+        _deserialize_payload(tier, payload, avals)
+    return payload
+
+
+def _deserialize_payload(tier: str, payload: bytes, avals: tuple,
+                         surface: str = "") -> Callable:
+    if tier == TIER_EXECUTABLE:
+        from jax.experimental import serialize_executable as se
+
+        return se.deserialize_and_load(*pickle.loads(payload))
+    import jax
+    from jax import export as jexport
+
+    exported = jexport.deserialize(bytearray(payload))
+    # compile-on-load: eager, so the warm-start readiness phase still means
+    # "compiled", not "will compile on the first request". This IS a real
+    # XLA compile, so it gets its own span that trace_report's recompile
+    # budget COUNTS — an export-tier load must never let "--max-compiles 0"
+    # report a recompiling respawn as warm (the executable tier's whole
+    # point is that it skips this).
+    with tracing.span("warmcache/load_compile", surface=surface, tier=tier,
+                      os_pid=os.getpid()):
+        return jax.jit(exported.call).lower(*avals).compile()
+
+
+# ---------------------------------------------------------------------------
+# The cache
+# ---------------------------------------------------------------------------
+
+@dataclass
+class WarmResult:
+    """What :func:`aot_compile` hands back."""
+
+    fn: Callable                 # ready-to-call compiled program
+    source: str                  # "cache" (warm load) | "compiled" (cold)
+    surface: str
+    key: str
+    lower_s: float               # AOT lowering time
+    build_s: float               # compile (cold) or deserialize (warm) time
+    entry: Optional[Path] = None
+
+
+class WarmCache:
+    """Persistent executable cache directory (shared by N processes).
+
+    Thread-safe within a process; cross-process safety is by construction:
+    single-file entries written via temp + atomic ``os.replace`` (last
+    writer wins; readers never see a torn file), and every load fully
+    verifies magic/lengths/sha/fingerprint before deserializing."""
+
+    def __init__(self, cache_dir: str | Path):
+        self.dir = Path(cache_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._lock = threading.Lock()
+        self._load_seq = 0
+
+    def counter(self, name: str):
+        return tracing.registry().counter(f"warmcache/{name}")
+
+    def entry_path(self, surface: str, key: str) -> Path:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in surface)
+        return self.dir / f"{safe}.{key}.wce"
+
+    # -- load ----------------------------------------------------------------
+
+    def load(self, surface: str, key: str, fingerprint: dict,
+             avals: tuple) -> Optional[Callable]:
+        """Deserialize a verified entry, or None (miss / quarantined).
+
+        Every verification failure is LOUD (structured log + ``warmcache/*``
+        fault counter) and quarantines the entry file out of the key space,
+        so the next incarnation is not poisoned by the same bytes."""
+        path = self.entry_path(surface, key)
+        try:
+            blob = R.read_bytes_with_retry(path, name=f"warmcache:{surface}")
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            R.log_event("warmcache_read_error", surface=surface, error=repr(e))
+            R.bump_counter("warmcache/read_error")
+            return None
+        with self._lock:
+            seq = self._load_seq
+            self._load_seq += 1
+        from dcr_tpu.utils import faults
+
+        if faults.fire("cache_corrupt", load=seq):
+            # deterministic CI poisoning: damage the blob in memory so the
+            # REAL verification/quarantine/recompile path runs end to end
+            mid = len(MAGIC) + _LEN.size + 1
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] \
+                if len(blob) > mid else b""
+        meta, payload, problem = self._verify(blob, fingerprint)
+        if problem is not None:
+            kind, detail = problem
+            self._quarantine(path, surface, kind, detail)
+            return None
+        try:
+            t0 = time.monotonic()
+            with tracing.span("warmcache/load", surface=surface, key=key,
+                              tier=meta["tier"], os_pid=os.getpid()):
+                fn = _deserialize_payload(meta["tier"], payload, avals,
+                                          surface=surface)
+        except Exception as e:  # version-skewed/poisoned payload: recompile
+            self._quarantine(path, surface, "load_error", repr(e))
+            return None
+        self.counter("hits").inc()
+        tracing.event("warmcache/hit", surface=surface, key=key,
+                      tier=meta["tier"], os_pid=os.getpid(),
+                      load_s=round(time.monotonic() - t0, 3))
+        return fn
+
+    @staticmethod
+    def _verify(blob: bytes,
+                fingerprint: dict) -> tuple[Optional[dict], bytes,
+                                            Optional[tuple[str, str]]]:
+        """(meta, payload, problem) — problem is (fault kind, detail)."""
+        head = len(MAGIC) + _LEN.size
+        if len(blob) < head:
+            return None, b"", ("cache_truncated",
+                               f"{len(blob)} bytes < {head}-byte header")
+        if blob[:len(MAGIC)] != MAGIC:
+            return None, b"", ("cache_corrupt", "bad magic")
+        (meta_len,) = _LEN.unpack(blob[len(MAGIC):head])
+        if len(blob) < head + meta_len:
+            return None, b"", ("cache_truncated",
+                               f"meta length {meta_len} past EOF")
+        try:
+            meta = json.loads(blob[head:head + meta_len].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            return None, b"", ("cache_corrupt", f"meta unreadable: {e}")
+        payload = blob[head + meta_len:]
+        if len(payload) != meta.get("payload_len"):
+            return None, b"", (
+                "cache_truncated",
+                f"payload {len(payload)}B != recorded {meta.get('payload_len')}B")
+        if _sha(payload) != meta.get("payload_sha256"):
+            return None, b"", ("cache_corrupt", "payload sha256 mismatch")
+        if meta.get("fingerprint") != fingerprint:
+            return None, b"", (
+                "fingerprint_mismatch",
+                "entry fingerprint is not the requested program")
+        if meta.get("tier") not in (TIER_EXECUTABLE, TIER_EXPORT):
+            return None, b"", ("cache_corrupt",
+                               f"unknown tier {meta.get('tier')!r}")
+        return meta, payload, None
+
+    def _quarantine(self, path: Path, surface: str, kind: str,
+                    detail: str) -> None:
+        """Rename a bad entry out of the key space (so it can't poison the
+        next load) and make the recovery auditable."""
+        dest = _quarantine_rename(path)
+        R.log_event("warmcache_quarantined", surface=surface, kind=kind,
+                    detail=detail, entry=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        R.bump_counter(f"warmcache/{kind}")
+
+    # -- store ---------------------------------------------------------------
+
+    def store(self, surface: str, key: str, fingerprint: dict, tier: str,
+              payload: bytes) -> Optional[Path]:
+        """Atomic write-to-temp + rename; concurrent writers last-win.
+        Store failures are loud but never fail the caller — the compiled
+        program in memory is already correct."""
+        path = self.entry_path(surface, key)
+        meta = {
+            "version": CACHE_VERSION,
+            "surface": surface,
+            "tier": tier,
+            "fingerprint": fingerprint,
+            "payload_len": len(payload),
+            "payload_sha256": _sha(payload),
+            "created_at": time.time(),
+            "writer_pid": os.getpid(),
+        }
+        tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+        try:
+            # serialization inside the guard: a store failure of ANY kind
+            # must never fail the caller (the compiled program in memory is
+            # already correct)
+            meta_bytes = json.dumps(meta, sort_keys=True).encode("utf-8")
+            blob = MAGIC + _LEN.pack(len(meta_bytes)) + meta_bytes + payload
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        except (TypeError, ValueError, OSError) as e:
+            R.log_event("warmcache_store_error", surface=surface,
+                        error=repr(e))
+            R.bump_counter("warmcache/store_error")
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError as e2:
+                R.log_event("warmcache_store_cleanup_error", error=repr(e2))
+            return None
+        self.counter("stores").inc()
+        tracing.event("warmcache/store", surface=surface, key=key, tier=tier,
+                      bytes=len(blob), os_pid=os.getpid())
+        return path
+
+
+# ---------------------------------------------------------------------------
+# The one entry point call sites use
+# ---------------------------------------------------------------------------
+
+def aot_compile(surface: str, jit_fn, args: tuple, *,
+                static_config: Optional[dict] = None,
+                cache: Optional[WarmCache] = None) -> WarmResult:
+    """Lower ``jit_fn`` over ``args`` ahead of time and return a compiled
+    program — from ``cache`` when a verified entry exists, else compiled now
+    (and stored for the next incarnation when ``cache`` is given).
+
+    ``args`` may be live arrays (avals derived, shardings preserved),
+    ShapeDtypeStructs, or a mix. With ``cache=None`` this is plain AOT
+    compilation: the readiness phase still gets eager compiles and the
+    ``warmcache/compile`` span the recompile budget counts."""
+    t0 = time.monotonic()
+    avals = abstract_args(args)
+    with tracing.span("warmcache/lower", surface=surface,
+                      os_pid=os.getpid()):
+        lowered = jit_fn.lower(*avals)
+    lower_s = time.monotonic() - t0
+    fp = program_fingerprint(surface, lowered, avals,
+                             static_config=static_config)
+    key = entry_key(fp)
+    if cache is not None:
+        t1 = time.monotonic()
+        fn = cache.load(surface, key, fp, avals)
+        if fn is not None:
+            return WarmResult(fn=fn, source="cache", surface=surface,
+                              key=key, lower_s=lower_s,
+                              build_s=time.monotonic() - t1,
+                              entry=cache.entry_path(surface, key))
+        cache.counter("misses").inc()
+    t1 = time.monotonic()
+    with tracing.span("warmcache/compile", surface=surface, key=key,
+                      os_pid=os.getpid()):
+        compiled = lowered.compile()
+    build_s = time.monotonic() - t1
+    entry = None
+    if cache is not None:
+        tier = active_tier()
+        try:
+            payload = build_payload(tier, jit_fn, avals, compiled)
+        except Exception as e:
+            payload = None
+            if tier == TIER_EXECUTABLE:
+                # per-entry degrade: THIS executable's raw serialization is
+                # broken (see build_payload) — persist lowered StableHLO
+                # instead, which costs compile-on-load but survives
+                R.log_event("warmcache_store_degraded", surface=surface,
+                            error=repr(e), fallback=TIER_EXPORT)
+                R.bump_counter("warmcache/store_degraded")
+                try:
+                    tier = TIER_EXPORT
+                    payload = build_payload(tier, jit_fn, avals, compiled)
+                except Exception as e2:
+                    R.log_event("warmcache_serialize_error", surface=surface,
+                                tier=tier, error=repr(e2))
+                    R.bump_counter("warmcache/serialize_error")
+            else:
+                # an unserializable program (exotic custom calls) must not
+                # break serving — it just stays a per-process compile
+                R.log_event("warmcache_serialize_error", surface=surface,
+                            tier=tier, error=repr(e))
+                R.bump_counter("warmcache/serialize_error")
+        if payload is not None:
+            entry = cache.store(surface, key, fp, tier, payload)
+    return WarmResult(fn=compiled, source="compiled", surface=surface,
+                      key=key, lower_s=lower_s, build_s=build_s, entry=entry)
+
+
+def guarded(fast_fn: Callable, fallback: Callable, surface: str) -> Callable:
+    """Wrap a cache-loaded/AOT executable with a one-way degrade to the
+    original jit function: if the executable ever rejects its inputs
+    (aval/layout drift the fingerprint could not see — by construction this
+    should not happen, which is exactly why it must not be fatal when it
+    does), log, count, and serve from the jit path from then on."""
+    state = {"fast": True}
+
+    def call(*call_args):
+        if state["fast"]:
+            try:
+                return fast_fn(*call_args)
+            except (TypeError, ValueError) as e:
+                state["fast"] = False
+                R.log_event("warmcache_call_fallback", surface=surface,
+                            error=repr(e))
+                R.bump_counter("warmcache/call_fallback")
+        return fallback(*call_args)
+
+    call.__wrapped__ = fallback
+    return call
+
+
+# ---------------------------------------------------------------------------
+# Warm-start manifest (which programs the previous incarnation had resident)
+# ---------------------------------------------------------------------------
+
+MANIFEST_NAME = "warm_manifest.json"
+
+
+def _manifest_path(cache_dir: str | Path) -> Path:
+    return Path(cache_dir) / MANIFEST_NAME
+
+
+def read_warm_manifest(cache_dir: str | Path) -> list:
+    """The previous incarnation's warm set (list of JSON entries; for serve,
+    bucket tuples). Absent -> []. Corrupt -> quarantined + [] (a bad warm
+    hint must never block a boot — the worst case is a lazy compile)."""
+    path = _manifest_path(cache_dir)
+    try:
+        raw = R.read_text_with_retry(path, name="warm_manifest")
+    except FileNotFoundError:
+        return []
+    except OSError as e:
+        R.log_event("warm_manifest_read_error", error=repr(e))
+        R.bump_counter("warmcache/manifest_read_error")
+        return []
+    try:
+        doc = json.loads(raw)
+        entries = doc["entries"]
+        if not isinstance(entries, list):
+            raise ValueError(f"entries is {type(entries).__name__}, not list")
+        return entries
+    except (KeyError, ValueError, TypeError) as e:
+        dest = _quarantine_rename(path)
+        R.log_event("warm_manifest_corrupt", error=repr(e), path=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        R.bump_counter("warmcache/manifest_corrupt")
+        return []
+
+
+def update_warm_manifest(cache_dir: str | Path, entries: list,
+                         max_entries: Optional[int] = None) -> None:
+    """Union ``entries`` into the manifest in LRU order — a re-recorded
+    entry moves to the END (most-recent-last), and ``max_entries`` trims the
+    OLDEST from the front. Without the bound, a long-lived shared cache dir
+    would accumulate every bucket ever served and the warm plan would
+    eventually pre-consume a worker's whole resident-program budget with
+    stale buckets. Atomic replace; a lost update between concurrent workers
+    costs one lazy compile next boot, never corruption."""
+    path = _manifest_path(cache_dir)
+    canon_new = [json.dumps(e, sort_keys=True, default=str) for e in entries]
+    merged = [e for e in read_warm_manifest(cache_dir)
+              if json.dumps(e, sort_keys=True, default=str) not in canon_new]
+    seen: set = set()
+    for c in canon_new:
+        if c not in seen:
+            seen.add(c)
+            merged.append(json.loads(c))
+    if max_entries is not None and len(merged) > max_entries:
+        merged = merged[-max_entries:]
+    doc = {"version": CACHE_VERSION, "updated_at": time.time(),
+           "entries": merged}
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    try:
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+    except OSError as e:
+        R.log_event("warm_manifest_write_error", error=repr(e))
+        R.bump_counter("warmcache/manifest_write_error")

@@ -208,6 +208,13 @@ class Trainer:
             text_params=params["text"], vae_params=params["vae"])
         self.state = T.shard_train_state(self.state, self.mesh)
         self.step_fn = T.make_train_step(cfg, self.models, self.mesh)
+        # what the loop actually calls: the jit function by default, replaced
+        # by a warm-cache AOT executable (with a one-way jit fallback) when
+        # cfg.warm.dir is set (_warm_start, after restore) — so a preempted
+        # pod resumes without re-paying XLA. _pf_fn mirrors this for the
+        # params-finite rollback check.
+        self._step_call = self.step_fn
+        self._pf_fn = _params_finite
         self.train_key = rngmod.stream_key(root, "train")
         # same wandb project name as the reference trainer (diff_train.py:545)
         self.writer = MetricWriter(self.out_dir / "logs", config=to_dict(cfg),
@@ -345,7 +352,7 @@ class Trainer:
             # bytes round-tripped, not that they were ever sane) — rolling
             # back to it would just re-trip the guard, so quarantine it and
             # keep walking
-            if _params_finite(T.trainable_of(state, self.cfg.train_text_encoder)):
+            if self._pf_fn(T.trainable_of(state, self.cfg.train_text_encoder)):
                 break
             self.ckpt._quarantine_step(
                 ckpt_step, f"non-finite params (rollback from step {step})")
@@ -453,6 +460,75 @@ class Trainer:
             return 0
         return self.loader.epoch_bad_count
 
+    def _warm_start(self) -> None:
+        """Resolve the train step and the params-finite check through the
+        persistent executable cache (core/warmcache.py): with ``warm.dir``
+        set, a restarted/preempted run loads serialized executables keyed on
+        avals/shardings/donation/static-config/topology instead of paying
+        XLA again. Any cache problem degrades to the normal jit path —
+        warm start can slow a boot down by at most one fingerprint check."""
+        cfg = self.cfg
+        if not cfg.warm.dir:
+            return
+        if jax.process_count() > 1:
+            # multi-host lowering/dispatch must stay byte-identical across
+            # ranks; a per-host cache hit racing a peer's compile is a skew
+            # risk not worth the win here — preemption recovery on pods is
+            # already coordinated at the checkpoint layer
+            R.log_event("warmcache_skipped_multihost",
+                        processes=jax.process_count())
+            return
+        from dcr_tpu.core import warmcache
+
+        cache = warmcache.WarmCache(cfg.warm.dir)
+        bs = pmesh.batch_sharding(self.mesh)
+        local_bs = cfg.train_batch_size * jax.local_device_count()
+        px = cfg.data.resolution
+        # the EXACT pytree the loop feeds the step: the loader's Batch dict —
+        # pixel_values, input_ids AND the (jit-unused but aval-relevant)
+        # sample index — after pmesh.shard_batch placement
+        batch_avals = {
+            "pixel_values": jax.ShapeDtypeStruct(
+                (local_bs, px, px, 3), jnp.float32, sharding=bs),
+            "input_ids": jax.ShapeDtypeStruct(
+                (local_bs, cfg.model.text_max_length), jnp.int32,
+                sharding=bs),
+            "index": jax.ShapeDtypeStruct(
+                (local_bs,),
+                # the loader stamps int64; device placement canonicalizes it
+                # (int32 unless x64 is enabled) — mirror that, or the aval
+                # would never match the real batch
+                jax.dtypes.canonicalize_dtype(jnp.int64), sharding=bs),
+        }
+        static = {
+            "mixed_precision": cfg.mixed_precision,
+            "remat": cfg.remat,
+            "train_text_encoder": cfg.train_text_encoder,
+            "ema_decay": cfg.ema_decay,
+            "rand_noise_lam": cfg.rand_noise_lam,
+            "mixup_noise_lam": cfg.mixup_noise_lam,
+            "gradient_accumulation_steps":
+                cfg.optim.gradient_accumulation_steps,
+            "use_8bit_adam": cfg.optim.use_8bit_adam,
+            "max_grad_norm": cfg.optim.max_grad_norm,
+            "train_batch_size": cfg.train_batch_size,
+        }
+        with R.stage("train_warm"):
+            res = warmcache.aot_compile(
+                "train/step", self.step_fn,
+                (self.state, batch_avals, self.train_key),
+                static_config=static, cache=cache)
+            self._step_call = warmcache.guarded(res.fn, self.step_fn,
+                                                "train/step")
+            tree = T.trainable_of(self.state, cfg.train_text_encoder)
+            pf = warmcache.aot_compile("train/params_finite", _params_finite,
+                                       (tree,), static_config={}, cache=cache)
+            self._pf_fn = warmcache.guarded(pf.fn, _params_finite,
+                                            "train/params_finite")
+        log.info("warm start: train/step %s in %.2fs, params_finite %s "
+                 "(cache %s)", res.source, res.build_s, pf.source,
+                 cfg.warm.dir)
+
     def train(self) -> dict:
         try:
             return self._train_impl()
@@ -471,6 +547,11 @@ class Trainer:
             # a checkpoint a peer can't see) would desynchronize every
             # collective that follows — fail fast with the per-rank values
             self.coord.assert_same("resume_step", start_step)
+        # dcr-warm: pre-populate the step programs from the persistent
+        # executable cache AFTER restore (the state's avals/shardings are
+        # final here), so a preempted pod's first step is a cache load, not
+        # a recompile
+        self._warm_start()
         self.watchdog.start()
         steps_per_epoch = self.loader.steps_per_epoch()
         # All periodic cadences (log_every / save_steps / modelsavesteps /
@@ -529,8 +610,8 @@ class Trainer:
                 with profiling.capture():
                     with tracing.span("train/step", step=step):
                         sharded = pmesh.shard_batch(self.mesh, dict(batch))
-                        self.state, metrics = self.step_fn(self.state, sharded,
-                                                           self.train_key)
+                        self.state, metrics = self._step_call(
+                            self.state, sharded, self.train_key)
                 step += 1
                 imgs_last += global_bs
                 self.watchdog.beat(step)

@@ -104,6 +104,15 @@ class WorkerLease:
     lease_s: float
     started_at: float = field(default_factory=time.time)
     renewed_at: float = field(default_factory=time.time)
+    # warm-start readiness (dcr-warm): a worker publishes its lease EARLY
+    # (so the supervisor can watch warming progress and spawn_timeout_s
+    # covers the whole boot) with ready=False, then flips it once every
+    # bucket in its warm plan is compiled. The supervisor only attaches a
+    # dispatch channel to a ready lease — it never dispatches into a cold
+    # worker. Defaults keep hand-written / pre-dcr-warm leases dispatchable.
+    ready: bool = True
+    buckets_warm: int = -1    # -1 = not reported
+    buckets_total: int = -1
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.time()) \
@@ -116,10 +125,14 @@ class WorkerLease:
 def write_lease(paths: FleetPaths, lease: WorkerLease) -> Path:
     """Atomic publish/renew: write-to-temp + rename, so a reader never sees
     a torn lease (a corrupt control plane must be impossible by
-    construction, not just unlikely)."""
+    construction, not just unlikely). The temp name is per-THREAD, not just
+    per-process: the heartbeat thread renews concurrently with the main
+    thread's warm-ready flip, and a shared temp path would let one
+    os.replace race the other into FileNotFoundError."""
     paths.leases.mkdir(parents=True, exist_ok=True)
     target = paths.lease_file(lease.index)
-    tmp = target.with_suffix(f".tmp.{lease.pid}")
+    tmp = target.with_suffix(
+        f".tmp.{lease.pid}.{threading.get_ident()}")
     tmp.write_text(json.dumps(vars(lease), sort_keys=True) + "\n")
     os.replace(tmp, target)
     return target

@@ -143,8 +143,14 @@ class ServeHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         url = urlparse(self.path)
         if url.path == "/healthz":
-            # fleet supervisors report richer states (warming/failed);
-            # single-process services keep the historical ok/draining pair
+            # never "ok" before the warm plan is compiled: services report a
+            # readiness document ({status, buckets_warm, buckets_total} on a
+            # worker; worker readiness counts on a fleet supervisor) so
+            # balancers and supervisors can gate on actual compiled state
+            doc_fn = getattr(self.service, "health_doc", None)
+            if callable(doc_fn):
+                self._reply(200, doc_fn())
+                return
             health = getattr(self.service, "health", None)
             status = (health() if callable(health)
                       else "draining" if self.service.draining else "ok")

@@ -135,6 +135,11 @@ class _WorkerSlot:
             "port": lease.port if lease is not None else None,
             "lease_age_s": round(lease.age_s(), 3) if lease is not None else None,
             "consecutive_failures": self.consecutive_failures,
+            # warm-start readiness from the lease payload: a SPAWNING slot
+            # with ready=False is a live worker still compiling its warm plan
+            "ready": self.state == ALIVE,
+            "buckets_warm": lease.buckets_warm if lease is not None else None,
+            "buckets_total": lease.buckets_total if lease is not None else None,
         }
 
 
@@ -317,6 +322,11 @@ class FleetSupervisor:
         self._admitted_buckets: set[GenBucket] = set()
         self._buckets_lock = threading.Lock()
         self._vae_scale: Optional[int] = None     # learned from first lease
+        # health stays "warming" until the first worker reports READY:
+        # _vae_scale alone now arrives with the first warming (not-ready)
+        # lease so admission can open and queue early, but a balancer must
+        # not see "ok" while nothing can serve yet
+        self._ever_ready = False
         self._draining = False
         self._fatal = threading.Event()
         self._shutdown = threading.Event()
@@ -387,6 +397,7 @@ class FleetSupervisor:
             slot.state = ALIVE
             slot.lease = lease
             slot.alive_since = time.time()
+            self._ever_ready = True
             if self._vae_scale is None:
                 self._vae_scale = lease.vae_scale
             slot.channel = DispatchChannel(self, slot, lease)
@@ -455,6 +466,7 @@ class FleetSupervisor:
                             error=repr(e))
                 R.bump_counter("fleet_kill_errors")
         with self._lock:
+            slot.lease = None    # a warming (not-ready) lease may be attached
             retire = self._schedule_backoff_locked(slot)
         R.log_event("fleet_spawn_failed", worker=slot.index, reason=reason,
                     retired=retire)
@@ -491,15 +503,33 @@ class FleetSupervisor:
                 elif state == SPAWNING:
                     rc = slot.proc.poll()
                     lease = read_lease(self.paths, slot.index)
-                    if lease is not None and lease.pid == slot.proc.pid:
+                    ours = lease is not None and lease.pid == slot.proc.pid
+                    if ours and lease.ready:
+                        # dispatch is gated on READINESS, not liveness: a
+                        # worker publishes its lease with ready=False while
+                        # its warm plan compiles, and the channel only
+                        # attaches once the lease reports ready — the
+                        # supervisor never dispatches into a cold worker
                         self._worker_joined(slot, lease)
                         alive += 1
                     elif rc is not None:
                         self._spawn_failed(
-                            slot, f"exited rc={rc} before publishing a lease")
+                            slot, f"exited rc={rc} before publishing a "
+                            "ready lease")
                     elif now > slot.spawn_deadline:
-                        self._spawn_failed(slot, "no lease within "
-                                           f"{self.cfg.fleet.spawn_timeout_s}s")
+                        self._spawn_failed(slot, "no ready lease within "
+                                           f"{self.cfg.fleet.spawn_timeout_s}s"
+                                           " (spawn_timeout_s covers load + "
+                                           "warm start)")
+                    elif ours:
+                        # warming: surface progress in status() and learn the
+                        # model's vae scale early so admission can open (and
+                        # queue) while the first worker is still compiling
+                        with self._lock:
+                            if slot.state == SPAWNING:
+                                slot.lease = lease
+                                if self._vae_scale is None:
+                                    self._vae_scale = lease.vae_scale
                 elif state == BACKOFF:
                     channel_done = (slot.channel is None
                                     or slot.channel.finished())
@@ -866,9 +896,27 @@ class FleetSupervisor:
             return "failed"
         if self._draining:
             return "draining"
-        if self._vae_scale is None:
+        if self._vae_scale is None or not self._ever_ready:
+            # cold boot: no worker has EVER reached ready — "warming" even
+            # though admission may already be queueing. (After first ready,
+            # transient all-workers-down churn keeps reporting "ok" exactly
+            # as before dcr-warm: respawn is in flight, the queue holds.)
             return "warming"
         return "ok"
+
+    def health_doc(self) -> dict:
+        """The /healthz document: overall status plus worker readiness and
+        the fleet's aggregate warm-bucket counts (from lease payloads)."""
+        with self._lock:
+            ready = sum(1 for s in self._slots if s.state == ALIVE)
+            leases = [s.lease for s in self._slots if s.lease is not None]
+        return {
+            "status": self.health(),
+            "workers_ready": ready,
+            "workers_total": len(self._slots),
+            "buckets_warm": sum(max(0, l.buckets_warm) for l in leases),
+            "buckets_total": sum(max(0, l.buckets_total) for l in leases),
+        }
 
     def begin_drain(self) -> None:
         """Stop admission. The shared queue is NOT closed: requeues of

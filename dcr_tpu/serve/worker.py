@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import rng as rngmod
 from dcr_tpu.core import tracing
+from dcr_tpu.core import warmcache
 from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
@@ -273,7 +274,24 @@ class GenerationService:
         # a misconfigured default bucket must fail at STARTUP, not boot a
         # healthy-looking replica that 400s every default request
         validate_bucket(self.default_bucket(), vae_scale=self._vae_scale)
-        self._encode = make_text_encoder(stack.models)
+        # persistent executable cache (dcr-warm): compiled samplers/encoder
+        # are loaded from disk when a verified entry exists, so a respawn
+        # reaches ready without paying XLA again
+        self._warmcache = (warmcache.WarmCache(cfg.warm.dir)
+                           if cfg.warm.dir else None)
+        # serializes AOT compiles; kept separate from _samplers_lock so a
+        # multi-second compile never blocks admission threads checking the
+        # bucket budget
+        self._build_lock = threading.Lock()
+        # warm-start readiness: begin_warm() computes the plan and flips
+        # health to "warming"; warm_start() compiles it and flips back. The
+        # event starts SET so in-process services that never warm (tests,
+        # benches) report "ok" exactly as before dcr-warm.
+        self._warm_plan: Optional[list[GenBucket]] = None
+        self._warm_complete = threading.Event()
+        self._warm_complete.set()
+        self._encode_jit = make_text_encoder(stack.models)
+        self._encode = self._encode_jit
         self._tok_fp = stack.tokenizer.fingerprint()
         self._uncond: Optional[np.ndarray] = None
         self._stop = threading.Event()
@@ -387,17 +405,169 @@ class GenerationService:
     def _sampler_for(self, bucket: GenBucket):
         with self._samplers_lock:
             fn = self._samplers.get(bucket)
-            if fn is None:
-                log.info("serve: compiling sampler for bucket %s at batch=%d",
-                         bucket, self.cfg.max_batch)
-                # trace_report counts these per bucket: with resident-program
-                # reuse working, each bucket compiles exactly once per process
-                tracing.event("serve/compile", bucket=str(tuple(bucket)),
-                              max_batch=self.cfg.max_batch)
-                fn = make_batch_sampler(bucket, self.stack.models,
-                                        self.cfg.seed, self.cfg.max_batch)
-                self._samplers[bucket] = fn
+        if fn is not None:
             return fn
+        with self._build_lock:
+            # double-checked: the worker thread and warm_start can race on
+            # the same bucket; the second builder reuses the first's program
+            with self._samplers_lock:
+                fn = self._samplers.get(bucket)
+                if fn is not None:
+                    return fn
+            fn = self._build_sampler(bucket)
+            with self._samplers_lock:
+                self._samplers[bucket] = fn
+        return fn
+
+    def _build_sampler(self, bucket: GenBucket):
+        """AOT-lower the bucket's sampler and resolve it through the warm
+        cache: a verified cache entry deserializes in O(load); otherwise XLA
+        compiles now and the executable is persisted for the next
+        incarnation. Returns a ready-to-call program (with a one-way degrade
+        to the plain jit path should the executable ever reject its inputs)."""
+        L = self.stack.model_cfg.text_max_length
+        D = self.stack.model_cfg.text_hidden_size
+        jit_fn = make_batch_sampler(bucket, self.stack.models,
+                                    self.cfg.seed, self.cfg.max_batch)
+        emb = jax.ShapeDtypeStruct((self.cfg.max_batch, L, D), jnp.float32)
+        seeds = jax.ShapeDtypeStruct((self.cfg.max_batch,), jnp.uint32)
+        res = warmcache.aot_compile(
+            "serve/batch_sampler", jit_fn,
+            (self.stack.params, emb, emb, seeds),
+            static_config={
+                "resolution": bucket.resolution, "steps": bucket.steps,
+                "guidance": bucket.guidance, "sampler": bucket.sampler,
+                "rand_noise_lam": bucket.rand_noise_lam,
+                "max_batch": self.cfg.max_batch,
+            },
+            cache=self._warmcache)
+        if res.source == "cache":
+            log.info("serve: bucket %s warm-loaded from cache in %.2fs "
+                     "(batch=%d)", bucket, res.build_s, self.cfg.max_batch)
+        else:
+            # trace_report counts these per bucket AND per process
+            # incarnation (os_pid): a warm respawn must show zero
+            log.info("serve: compiled sampler for bucket %s at batch=%d "
+                     "in %.2fs", bucket, self.cfg.max_batch, res.build_s)
+            tracing.event("serve/compile", bucket=str(tuple(bucket)),
+                          max_batch=self.cfg.max_batch, os_pid=os.getpid())
+        if self._warmcache is not None and self._warm_complete.is_set():
+            # record a lazily admitted bucket for the NEXT incarnation's
+            # warm plan. LRU + budget-capped: active buckets move to the
+            # manifest tail, stale ones age out the front — a long-lived
+            # shared cache dir can never fill every future incarnation's
+            # resident-program budget with history. During the warm phase
+            # itself this is skipped: warm_start() records the whole plan in
+            # ONE batched update instead of a read-merge-rewrite per bucket.
+            warmcache.update_warm_manifest(
+                self.cfg.warm.dir, [list(tuple(bucket))],
+                max_entries=self.cfg.max_compiled_buckets)
+        return warmcache.guarded(res.fn, jit_fn, "serve/batch_sampler")
+
+    # -- warm-start readiness (dcr-warm) -------------------------------------
+
+    def begin_warm(self) -> int:
+        """Enter the warming state and compute the warm plan: the default
+        bucket plus valid buckets from the previous incarnation's warm
+        manifest NEWEST-first (the manifest is LRU-ordered), capped by the
+        compiled-bucket budget. /healthz reports "warming" from here until
+        :meth:`warm_start` finishes. Returns the plan size (0 = warm start
+        disabled)."""
+        if not self.cfg.warm.warm_start:
+            return 0
+        plan = [self.default_bucket()]
+        if self._warmcache is not None:
+            from dcr_tpu.serve.fleet import bucket_from_tuple
+
+            for entry in reversed(
+                    warmcache.read_warm_manifest(self.cfg.warm.dir)):
+                try:
+                    b = bucket_from_tuple(entry)
+                    validate_bucket(b, vae_scale=self._vae_scale)
+                except (TypeError, ValueError, InvalidRequestError) as e:
+                    # a stale hint (config change, hand edit) costs a log
+                    # line, never a boot
+                    R.log_event("warm_manifest_entry_invalid", entry=entry,
+                                error=repr(e))
+                    R.bump_counter("warmcache/manifest_entry_invalid")
+                    continue
+                if b not in plan:
+                    plan.append(b)
+        # the plan must leave ADMISSION HEADROOM: warm buckets enter
+        # _admitted_buckets (they are resident programs), and compiled
+        # programs never evict — a plan that filled the whole budget with
+        # the previous incarnation's traffic would 503 every novel bucket
+        # for this process's lifetime AND keep the manifest from ever
+        # learning the new traffic (rejected buckets never compile). One
+        # reserved slot breaks that wedge: the novel bucket admits,
+        # compiles, and the LRU manifest warms it next incarnation.
+        cap = max(1, self.cfg.max_compiled_buckets - 1)
+        if len(plan) > cap:
+            R.log_event("warm_plan_over_budget", planned=len(plan), cap=cap,
+                        budget=self.cfg.max_compiled_buckets)
+            plan = plan[:cap]
+        self._warm_plan = plan
+        self._warm_complete.clear()
+        return len(plan)
+
+    def warm_start(self) -> dict:
+        """Execute the warm plan: text encoder + uncond embedding first
+        (every batch needs them), then one resident program per planned
+        bucket — each from the persistent cache when a verified entry
+        exists. Flips /healthz from "warming" to "ok" when done."""
+        if not self.cfg.warm.warm_start:
+            return {"buckets_warm": 0, "buckets_total": 0, "seconds": 0.0}
+        if self._warm_plan is None:
+            self.begin_warm()
+        t0 = time.monotonic()
+        self._warm_encoder()
+        self._uncond_embedding()
+        for bucket in self._warm_plan:
+            with self._samplers_lock:
+                self._admitted_buckets.add(bucket)
+            self._sampler_for(bucket)
+        if self._warmcache is not None:
+            # one batched manifest update for the whole plan (per-bucket
+            # updates during warming are suppressed in _build_sampler)
+            warmcache.update_warm_manifest(
+                self.cfg.warm.dir,
+                [list(tuple(b)) for b in self._warm_plan],
+                max_entries=self.cfg.max_compiled_buckets)
+        self._warm_complete.set()
+        doc = {"buckets_warm": len(self._warm_plan),
+               "buckets_total": len(self._warm_plan),
+               "seconds": round(time.monotonic() - t0, 3)}
+        R.log_trace("warm_start_done", **doc)
+        return doc
+
+    def _warm_encoder(self) -> None:
+        """AOT the text-encoder program through the warm cache (the tower
+        every cache-miss embedding pays)."""
+        ids = self.stack.tokenizer([""])
+        res = warmcache.aot_compile(
+            "serve/encode", self._encode_jit,
+            (self.stack.params["text"], ids),
+            static_config={
+                "text_max_length": self.stack.model_cfg.text_max_length},
+            cache=self._warmcache)
+        self._encode = warmcache.guarded(res.fn, self._encode_jit,
+                                         "serve/encode")
+
+    def health(self) -> str:
+        if self.draining:
+            return "draining"
+        if not self._warm_complete.is_set():
+            return "warming"
+        return "ok"
+
+    def health_doc(self) -> dict:
+        """The /healthz document: never plain "ok" before the warm plan is
+        compiled — balancers and the fleet supervisor gate on it."""
+        with self._samplers_lock:
+            warm = len(self._samplers)
+        total = max(len(self._warm_plan or ()), warm)
+        return {"status": self.health(), "buckets_warm": warm,
+                "buckets_total": total}
 
     def _uncond_embedding(self) -> np.ndarray:
         if self._uncond is None:

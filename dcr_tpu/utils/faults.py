@@ -36,6 +36,12 @@ coordinated-recovery tests. Supported kinds and their hook points:
 - ``slow_step`` — serve batch loop, coord ``batch``: sleeps
   ``DCR_SLOW_STEP_S`` (default 30) seconds before the device step — a
   straggler, for latency/SLO chaos rather than death.
+- ``cache_corrupt`` — warm-cache load (core/warmcache.py), coord ``load``
+  (per-process load attempt index): damages the just-read entry blob in
+  memory so the REAL verification path runs — quarantine rename, a
+  ``warmcache/*`` fault counter, and a clean recompile. This is how CI
+  proves a poisoned executable cache can never crash a boot or load a
+  wrong program. ``cache_corrupt@load=0`` poisons the first load.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

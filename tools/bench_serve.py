@@ -11,12 +11,18 @@ and excluded. Writes BENCH_SERVE.json. Acceptance: batched > sequential.
 ``--chaos`` drives a real fleet (in-process FleetSupervisor, real worker
 SUBPROCESSES spawned through ``dcr_tpu.cli.serve``): the same fixed request
 load runs twice — once uninjected (baseline p99), once while a kill loop
-SIGKILLs an alive worker every K seconds (targets found via the fleet lease
-directory). Writes BENCH_SERVE_CHAOS.json with availability %, the
-dropped-accepted-request count replayed from the durable journal (MUST be
-0 — the process exits 1 otherwise), p99 with/without churn, and whether
-every churn-run response was bit-identical to the uninjected run (it must
-be: every image is a pure function of (ckpt, prompt, seed, bucket)).
+SIGKILLs a READY worker every K seconds (targets found via the fleet lease
+directory). Both runs share one dcr-warm persistent executable cache: the
+baseline populates it cold, so its boot-to-ready times are the COLD numbers,
+while every churn boot and respawn must come up WARM. Writes
+BENCH_SERVE_CHAOS.json with availability %, the dropped-accepted-request
+count replayed from the durable journal (MUST be 0 — the process exits 1
+otherwise), p99 with/without churn, whether every churn-run response was
+bit-identical to the uninjected run (it must be: every image is a pure
+function of (ckpt, prompt, seed, bucket)), per-kill crash-to-ready and
+crash-to-first-completion times (cold vs warm cache), and the trace-verified
+compile count per process incarnation — a warm respawn that recompiles ANY
+bucket fails the bench.
 
 Usage: python tools/bench_serve.py [--chaos]
 Env knobs (default mode): BENCH_SERVE_REQUESTS (default 32),
@@ -165,17 +171,21 @@ def _export_tiny_ckpt(dirpath: Path) -> Path:
 
 
 def _chaos_config(ckpt: Path, fleet_dir: Path, *, workers: int, steps: int,
-                  res: int):
-    from dcr_tpu.core.config import FleetConfig, ServeConfig
+                  res: int, warm_dir: Path):
+    from dcr_tpu.core.config import (FleetConfig, ServeConfig,
+                                     WarmCacheConfig)
 
     # churn-friendly knobs: quick death detection (tight lease), quick
     # respawn (short backoff, high budget — the bench wants churn, not
     # retirement), and enough dispatch attempts that a request surviving
-    # several kills still completes rather than 500s
+    # several kills still completes rather than 500s. The shared warm_dir is
+    # the persistent executable cache: the baseline run populates it cold,
+    # and every churn (re)spawn must reach ready from it with ZERO compiles.
     return ServeConfig(
         model_path=str(ckpt), resolution=res, num_inference_steps=steps,
         sampler="ddim", max_batch=4, max_wait_ms=50.0, queue_depth=512,
         request_timeout_s=600.0, seed=0,
+        warm=WarmCacheConfig(dir=str(warm_dir)),
         fleet=FleetConfig(workers=workers, dir=str(fleet_dir),
                           heartbeat_s=0.5, lease_s=3.0,
                           dispatch_timeout_s=300.0, spawn_timeout_s=300.0,
@@ -184,26 +194,51 @@ def _chaos_config(ckpt: Path, fleet_dir: Path, *, workers: int, steps: int,
 
 
 def _kill_loop(paths, workers: int, every_s: float, stop, kills: list) -> None:
-    """SIGKILL one alive worker every ``every_s`` seconds, targets found the
+    """SIGKILL one READY worker every ``every_s`` seconds, targets found the
     way any out-of-process chaos tool would: the lease directory. The victim
     is the LONGEST-ALIVE worker (oldest ``started_at``): killing the first
     alive index would keep executing a fresh respawn the moment it joined,
     which models a crash-looping binary rather than churn — under that
     regime nothing can complete anywhere and "availability" measures the
-    kill cadence, not the fleet."""
+    kill cadence, not the fleet.
+
+    First blood lands deterministically MID-FLIGHT: the loop watches the
+    durable journal for the first ``dispatch`` record before striking. With
+    the dcr-warm executable cache a fully warm fleet can finish the entire
+    workload in well under a second — any fixed first-kill delay races the
+    workload, and a churn run with zero kills proves nothing (chaos_main
+    fails it)."""
     import signal
 
     from dcr_tpu.serve.fleet import read_lease
 
-    # first blood comes fast: with a warm compile cache the whole workload
-    # can finish inside one full interval, and a churn run with zero kills
-    # proves nothing (chaos_main fails it)
-    delay = min(every_s, 1.5)
-    while not stop.wait(delay):
-        delay = every_s
-        alive = [l for l in (read_lease(paths, i) for i in range(workers))
-                 if l is not None and not l.expired()]
-        for lease in sorted(alive, key=lambda l: l.started_at):
+    def ready_leases():
+        # only READY leases are victims: killing a still-warming spawn would
+        # measure spawn time, not crash-to-ready recovery
+        return [l for l in (read_lease(paths, i) for i in range(workers))
+                if l is not None and not l.expired() and l.ready]
+
+    def dispatched() -> bool:
+        # parsed, not substring-matched: the trigger must not couple to
+        # json.dumps separator defaults (the journal is tiny this early —
+        # admission has barely begun)
+        try:
+            lines = paths.journal.read_text().splitlines()
+        except OSError:
+            return False
+        for line in lines:
+            try:
+                if line.strip() and json.loads(line).get("op") == "dispatch":
+                    return True
+            except ValueError:
+                continue
+        return False
+
+    while not stop.wait(0.02):
+        if dispatched():
+            break
+    while not stop.wait(0.02 if not kills else every_s):
+        for lease in sorted(ready_leases(), key=lambda l: l.started_at):
             try:
                 os.kill(lease.pid, signal.SIGKILL)
             except OSError:
@@ -215,6 +250,73 @@ def _kill_loop(paths, workers: int, every_s: float, stop, kills: list) -> None:
             break
 
 
+def _watch_leases(paths, workers: int, stop, events: list) -> None:
+    """Record every (worker, pid, ready) lease transition with a wall-clock
+    stamp — the out-of-process observer the time-to-ready numbers come from
+    (the same files any ops tooling would watch)."""
+    from dcr_tpu.serve.fleet import read_lease
+
+    seen: dict = {}
+    while not stop.wait(0.05):
+        for i in range(workers):
+            lease = read_lease(paths, i)
+            if lease is None:
+                continue
+            cur = (lease.pid, bool(lease.ready))
+            if seen.get(i) != cur:
+                seen[i] = cur
+                events.append({"t": time.time(), "worker": i,
+                               "pid": lease.pid, "ready": bool(lease.ready)})
+
+
+def _journal_ack_times(journal_path) -> list:
+    """[(t, worker)] for every ack in the durable journal — the
+    time-to-first-completion anchor after a respawn."""
+    acks = []
+    for line in Path(journal_path).read_text().splitlines():
+        if not line.strip():
+            continue
+        rec = json.loads(line)
+        if rec.get("op") == "ack":
+            acks.append((rec["t"], rec.get("worker", -1)))
+    return sorted(acks)
+
+
+def _respawn_metrics(kills: list, lease_events: list, acks: list) -> list:
+    """Per-kill crash-to-ready and crash-to-first-completion times, from the
+    lease transitions and the journal alone."""
+    out = []
+    for k in kills:
+        w, t_kill = k["worker"], k["t"]
+        ready = next((e for e in lease_events
+                      if e["worker"] == w and e["ready"] and e["t"] > t_kill
+                      and e["pid"] != k["pid"]), None)
+        row = {"worker": w,
+               "time_to_ready_s": (round(ready["t"] - t_kill, 3)
+                                   if ready else None),
+               "time_to_first_completion_s": None,
+               "respawn_pid": ready["pid"] if ready else None}
+        if ready is not None:
+            ack = next((t for t, aw in acks
+                        if aw == w and t > ready["t"]), None)
+            if ack is not None:
+                row["time_to_first_completion_s"] = round(ack - t_kill, 3)
+        out.append(row)
+    return out
+
+
+def _compiles_by_pid(fleet_dir: Path) -> dict:
+    """XLA compiles per process incarnation across the fleet's trace files
+    (tools/trace_report's recompile-budget counter)."""
+    from tools import trace_report as TR
+
+    records, errors, _ = TR.load_fleet([Path(fleet_dir)], TR.load_schema())
+    if errors:
+        print(f"chaos: {len(errors)} invalid trace record(s) under "
+              f"{fleet_dir} (first: {errors[0]})", flush=True)
+    return TR.compiles_per_incarnation(records)
+
+
 def _run_fleet_workload(cfg, jobs, *, kill_every_s=None) -> dict:
     """One fleet run: submit every (prompt, seed) job concurrently, return
     response docs keyed by job plus availability/latency/journal numbers."""
@@ -223,10 +325,18 @@ def _run_fleet_workload(cfg, jobs, *, kill_every_s=None) -> dict:
     from dcr_tpu.serve.fleet import RequestJournal
     from dcr_tpu.serve.supervisor import FleetSupervisor
 
+    t_start = time.time()
     sup = FleetSupervisor(cfg)
     sup.start()
+    stop_watch = threading.Event()
+    lease_events: list = []
+    watcher = threading.Thread(
+        target=_watch_leases,
+        args=(sup.paths, cfg.fleet.workers, stop_watch, lease_events),
+        daemon=True, name="chaos-lease-watch")
+    watcher.start()
     deadline = time.monotonic() + cfg.fleet.spawn_timeout_s
-    while sup.health() != "ok":
+    while sup.health() != "ok" or sup.status()["workers_alive"] == 0:
         if time.monotonic() > deadline:
             raise RuntimeError(
                 f"fleet did not come up: health={sup.health()!r} "
@@ -259,16 +369,67 @@ def _run_fleet_workload(cfg, jobs, *, kill_every_s=None) -> dict:
         except Exception as e:
             failed[f"{job[0]}#{job[1]}"] = repr(e)   # str key: JSON-safe
     total_s = time.perf_counter() - t0
+    # latency percentiles snapshot BEFORE the post-respawn probe phase: the
+    # banked p50/p99 must describe the measured workload only, or the churn
+    # run's tail would be diluted by probes the baseline never sends
+    pct = sup.metrics.latency.percentiles((50, 99))
 
     stop_kills.set()
     if killer is not None:
         killer.join(timeout=2 * (kill_every_s or 1.0))
+    # observe crash-to-ready recovery BEFORE draining: a short workload can
+    # finish on survivors while the victim is still respawning — without
+    # this wait the bench would bank nulls instead of time-to-ready. Then a
+    # probe workload gives the respawned worker completions, so
+    # time-to-first-completion is measurable too.
+    probe_done = 0
+    if kills:
+        deadline = time.monotonic() + 90.0
+        def respawn_ready(k):
+            return any(e["worker"] == k["worker"] and e["ready"]
+                       and e["pid"] != k["pid"] and e["t"] > k["t"]
+                       for e in lease_events)
+        while (not all(respawn_ready(k) for k in kills)
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        probe_reqs = []
+        for i in range(2 * cfg.fleet.workers * cfg.max_batch):
+            try:
+                probe_reqs.append(sup.submit("post-respawn probe",
+                                             seed=100_000 + i))
+            except Exception as e:
+                print(f"chaos: probe rejected: {e!r}", flush=True)
+        for req in probe_reqs:
+            try:
+                req.future.result(timeout=cfg.request_timeout_s)
+                probe_done += 1
+            except Exception as e:
+                print(f"chaos: probe failed: {e!r}", flush=True)
     sup.begin_drain()
     sup.join_drained(cfg.request_timeout_s)
     sup.shutdown()
+    stop_watch.set()
+    watcher.join(timeout=2.0)
     replay = RequestJournal.replay(sup.paths.journal)
+    acks = _journal_ack_times(sup.paths.journal)
+    # crash-to-ready / crash-to-first-completion per kill, and initial
+    # boot-to-ready per worker (the cold-vs-warm cache comparison)
+    first_ready = {}
+    first_pids = {}
+    for e in lease_events:
+        first_pids.setdefault(e["worker"], e["pid"])
+        if e["ready"] and e["worker"] not in first_ready:
+            first_ready[e["worker"]] = e["t"]
+    boot_ttr = [round(t - t_start, 3) for _, t in sorted(first_ready.items())]
+    # compiles per incarnation from the fleet's trace files, split into the
+    # first (boot) incarnation of each worker vs respawns: a warm respawn
+    # performing ANY compile is a bench failure (chaos_main enforces it)
+    compiles = _compiles_by_pid(Path(cfg.fleet.dir))
+    boot_pids = {str(p) for p in first_pids.values()}
+    respawn_compiles = {
+        inc: n for inc, n in compiles.items()
+        if inc.rpartition("@pid")[2] not in boot_pids and n > 0}
 
-    pct = sup.metrics.latency.percentiles((50, 99))
     n_acc = len(accepted)
     return {
         "attempted": len(jobs),
@@ -282,6 +443,11 @@ def _run_fleet_workload(cfg, jobs, *, kill_every_s=None) -> dict:
         "latency_ms": {k: round(v * 1000.0, 3) for k, v in pct.items()},
         "kills": kills,
         "journal": replay["counts"],
+        "boot_time_to_ready_s": boot_ttr,
+        "respawns": _respawn_metrics(kills, lease_events, acks),
+        "probes_completed": probe_done,
+        "compiles_per_incarnation": compiles,
+        "respawn_compiles": respawn_compiles,
         "results": completed,
     }
 
@@ -306,13 +472,16 @@ def chaos_main() -> None:
     steps = int(os.environ.get("BENCH_SERVE_STEPS", "4"))
     res = int(os.environ.get("BENCH_SERVE_RES", "16"))
 
-    # share one persistent XLA compile cache across worker (re)spawns —
-    # respawned workers then reload in seconds instead of recompiling
-    repo = Path(__file__).resolve().parent.parent
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                          str(repo / "tests" / ".jax_cache_cpu"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1.0")
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+    # deliberately NO JAX persistent compile cache: dcr-warm's executable
+    # cache is the thing under test, the baseline leg must be genuinely
+    # COLD, and with XLA's cache active this jaxlib's CPU backend emits
+    # executables whose raw serialization is broken — every entry would
+    # degrade to the export tier, whose compile-on-load is (correctly)
+    # counted by the recompile budget and would fail the zero-compile
+    # respawn gate below. Strip the vars in case the caller's shell set them.
+    for k in list(os.environ):
+        if k.startswith("JAX_COMPILATION") or k.startswith("JAX_PERSISTENT"):
+            os.environ.pop(k)
 
     print(f"bench_serve --chaos: {n_requests} requests, {workers} workers, "
           f"kill every {kill_every_s}s, steps={steps}, res={res}", flush=True)
@@ -321,14 +490,19 @@ def chaos_main() -> None:
     with tempfile.TemporaryDirectory(prefix="dcr-chaos-") as td:
         tmp = Path(td)
         ckpt = _export_tiny_ckpt(tmp)
+        # one persistent executable cache shared across BOTH runs: the
+        # baseline populates it cold (its boot_time_to_ready_s is the cold
+        # number), then every churn spawn AND respawn must come up warm —
+        # zero compiles, trace-verified below
+        warm_dir = tmp / "warmcache"
         baseline = _run_fleet_workload(
             _chaos_config(ckpt, tmp / "fleet_baseline", workers=workers,
-                          steps=steps, res=res), jobs)
+                          steps=steps, res=res, warm_dir=warm_dir), jobs)
         print("baseline:", json.dumps({k: v for k, v in baseline.items()
                                        if k != "results"}), flush=True)
         churn = _run_fleet_workload(
             _chaos_config(ckpt, tmp / "fleet_churn", workers=workers,
-                          steps=steps, res=res), jobs,
+                          steps=steps, res=res, warm_dir=warm_dir), jobs,
             kill_every_s=kill_every_s)
         print("churn:", json.dumps({k: v for k, v in churn.items()
                                     if k != "results"}), flush=True)
@@ -351,6 +525,15 @@ def chaos_main() -> None:
         "p99_ms_churn": churn["latency_ms"].get("p99"),
         "bit_identical_responses": not mismatched,
         "mismatched_jobs": [list(j) for j in mismatched],
+        # crash-to-ready recovery (dcr-warm): baseline boots are COLD (empty
+        # executable cache), churn boots and every respawn are WARM
+        "cold_boot_time_to_ready_s": baseline["boot_time_to_ready_s"],
+        "warm_boot_time_to_ready_s": churn["boot_time_to_ready_s"],
+        "warm_respawn_time_to_ready_s": [
+            r["time_to_ready_s"] for r in churn["respawns"]],
+        "warm_respawn_time_to_first_completion_s": [
+            r["time_to_first_completion_s"] for r in churn["respawns"]],
+        "respawn_compiles": churn["respawn_compiles"],
     }
     OUT_CHAOS.write_text(json.dumps(result, indent=2) + "\n")
     print(f"wrote {OUT_CHAOS}", flush=True)
@@ -368,6 +551,10 @@ def chaos_main() -> None:
     if not churn["kills"]:
         problems.append("kill loop never fired — the churn run proved "
                         "nothing (workload too short for the cadence?)")
+    if churn["respawn_compiles"]:
+        problems.append(
+            f"warm respawn recompiled: {churn['respawn_compiles']} — the "
+            "persistent executable cache did not serve the respawned worker")
     if problems:
         print("CHAOS FAIL: " + "; ".join(problems), flush=True)
         raise SystemExit(1)

@@ -32,7 +32,6 @@ from pathlib import Path
 from typing import Any, Optional
 
 MANIFEST_VERSION = 1
-DETAIL_LEAVES = 24   # trees up to this many leaves keep per-leaf detail
 
 
 def _sha(text: str) -> str:
@@ -40,25 +39,16 @@ def _sha(text: str) -> str:
 
 
 def describe_avals(tree: Any) -> dict:
-    """Digestible description of a pytree of avals/arrays."""
-    import jax
+    """Digestible description of a pytree of avals/arrays.
 
-    leaves_with_path, _ = jax.tree_util.tree_flatten_with_path(tree)
-    lines = []
-    for path, leaf in leaves_with_path:
-        keystr = jax.tree_util.keystr(path) or "."
-        dtype = getattr(leaf, "dtype", type(leaf).__name__)
-        shape = tuple(getattr(leaf, "shape", ()))
-        sharding = getattr(leaf, "sharding", None)
-        desc = f"{keystr}: {dtype}{list(shape)}"
-        if sharding is not None:
-            desc += f" @ {sharding}"
-        lines.append(desc)
-    lines.sort()
-    out = {"leaves": len(lines), "digest": _sha("\n".join(lines))[:16]}
-    out["detail"] = lines if len(lines) <= DETAIL_LEAVES \
-        else lines[:4] + [f"... ({len(lines) - 4} more leaves)"]
-    return out
+    Delegates to :func:`dcr_tpu.core.warmcache.describe_avals` — the
+    manifest's aval fingerprints and the persistent executable cache's keys
+    come from ONE implementation, so an entry the manifest job accepts is by
+    construction the entry the warm cache would key identically (imported
+    lazily: this module stays stdlib-importable for ``--no-manifest``)."""
+    from dcr_tpu.core.warmcache import describe_avals as _describe
+
+    return _describe(tree)
 
 
 def fingerprint(name: str, fn, args: tuple, *, static_config: dict,

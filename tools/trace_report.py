@@ -28,9 +28,16 @@ validated against the checked-in ``tools/trace_schema.json``. The report:
 
 ``--chrome`` additionally writes a Chrome-trace JSON (``traceEvents`` array)
 loadable in Perfetto / chrome://tracing, one track (pid) per source
-process. Exit codes: 0 = report produced, 1 = no trace records found,
-2 = schema violations (the trace is corrupt or a writer drifted from the
-schema — CI fails on this).
+process. ``--max-compiles N`` is the recompile budget (ROADMAP item 3): the
+report counts XLA compiles (``serve/compile`` events + ``warmcache/compile``
+spans) per PROCESS INCARNATION — streams tell respawns apart by the
+``os_pid`` attr those records carry — and exits 3 when any incarnation
+exceeds N, so a code change that silently introduces new recompiles (or a
+respawn that should have been served from the persistent executable cache)
+fails pre-merge. Exit codes: 0 = report produced (budget OK when given),
+1 = no trace records found, 2 = schema violations (the trace is corrupt or
+a writer drifted from the schema — CI fails on this), 3 = recompile budget
+exceeded.
 
 Pure stdlib on purpose (like tools/lint): runs on a bare checkout.
 """
@@ -329,6 +336,37 @@ def fleet_summary(records: list[dict], meta: dict) -> dict | None:
     }
 
 
+def compiles_per_incarnation(records: list[dict]) -> dict[str, int]:
+    """XLA compiles per PROCESS INCARNATION — the recompile-budget unit.
+
+    A respawned worker appends to the same per-stream trace file, so
+    incarnations within a stream are told apart by the ``os_pid`` attr that
+    ``serve/compile`` events and ``warmcache/compile`` spans carry (each
+    respawn is a fresh pid). Per group the count is
+    ``max(warmcache/compile spans, serve/compile events)``: on dcr-warm
+    streams every real compile produces a warmcache span (bucket compiles
+    additionally emit the serve event — counting both would double-bill),
+    while pre-dcr-warm traces have only the events.
+    ``warmcache/load_compile`` spans (an export-tier cache entry's
+    compile-on-load) count too: they are real XLA compiles, and excluding
+    them would let a broken executable tier pass a ``--max-compiles 0``
+    gate while every boot silently recompiles."""
+    spans: dict[str, int] = {}
+    events: dict[str, int] = {}
+    for r in records:
+        if r["ph"] == "X" and r["name"] in ("warmcache/compile",
+                                            "warmcache/load_compile"):
+            bucket = spans
+        elif r["ph"] == "i" and r["name"] == "serve/compile":
+            bucket = events
+        else:
+            continue
+        key = f"{r['_plabel']}@pid{r['args'].get('os_pid', '?')}"
+        bucket[key] = bucket.get(key, 0) + 1
+    return {k: max(spans.get(k, 0), events.get(k, 0))
+            for k in sorted(set(spans) | set(events))}
+
+
 def summarize(records: list[dict], meta: dict | None = None) -> dict:
     """The report document (also the --json output)."""
     spans = [r for r in records if r["ph"] == "X"]
@@ -387,6 +425,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "by_name": names,
         "serve_queue_wait": queue_wait,
         "serve_recompiles_per_bucket": recompiles,
+        "compiles_per_incarnation": compiles_per_incarnation(records),
         "fault_timeline": faults,
         "fleet": fleet_summary(records, meta or {}),
     }
@@ -472,6 +511,10 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         lines.append("serve compiles per bucket:")
         for bucket, n in sorted(summary["serve_recompiles_per_bucket"].items()):
             lines.append(f"  {n}x {bucket}")
+    if summary.get("compiles_per_incarnation"):
+        lines.append("XLA compiles per process incarnation:")
+        for inc, n in summary["compiles_per_incarnation"].items():
+            lines.append(f"  {n}x {inc}")
     if summary["fault_timeline"]:
         lines.append("\nfault timeline:")
         for f in summary["fault_timeline"]:
@@ -506,6 +549,13 @@ def main(argv=None) -> int:
                          "(one track per source process)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of text")
+    ap.add_argument("--max-compiles", type=int, default=None, metavar="N",
+                    help="recompile budget: fail (exit 3) when any process "
+                         "incarnation (stream + os_pid) performed more than "
+                         "N XLA compiles (serve/compile events and "
+                         "warmcache/compile spans). --max-compiles 0 asserts "
+                         "a fully warm run — e.g. a respawned worker served "
+                         "entirely from the persistent executable cache")
     args = ap.parse_args(argv)
 
     for p in args.paths:
@@ -531,6 +581,18 @@ def main(argv=None) -> int:
         print(f"trace_report: wrote chrome trace -> {args.chrome}", file=sys.stderr)
     print(json.dumps(summary, indent=1) if args.json
           else render_text(summary, args.paths))
+    if args.max_compiles is not None:
+        over = {inc: n for inc, n
+                in summary["compiles_per_incarnation"].items()
+                if n > args.max_compiles}
+        if over:
+            for inc, n in over.items():
+                print(f"trace_report: RECOMPILE BUDGET: {inc} performed "
+                      f"{n} compile(s) > budget {args.max_compiles}",
+                      file=sys.stderr)
+            return 3
+        print(f"trace_report: recompile budget OK (<= {args.max_compiles} "
+              f"per incarnation)", file=sys.stderr)
     return 0
 
 
