@@ -203,7 +203,8 @@ def _run_worker(cfg: ServeConfig) -> None:
             index=index, pid=os.getpid(), port=port,
             vae_scale=vae_scale_factor(stack.models.vae.config),
             lease_s=cfg.fleet.lease_s,
-            ready=False, buckets_warm=0, buckets_total=planned)
+            ready=False, buckets_warm=0, buckets_total=planned,
+            risk=service.risk_status())
         heartbeat = LeaseHeartbeat(paths, lease,
                                    cfg.fleet.heartbeat_s).start()
         log.info("fleet worker %d warming: lease %s (heartbeat %.1fs, "
@@ -219,14 +220,34 @@ def _run_worker(cfg: ServeConfig) -> None:
         # stale-but-warming lease, never a ready-with-stale-counts one)
         lease.buckets_warm = warm["buckets_warm"]
         lease.buckets_total = warm["buckets_total"]
+        lease.risk = service.risk_status()
         lease.ready = True
         write_lease(paths, lease)
-        log.info("fleet worker %d ready: %d/%d bucket(s) warm in %.2fs",
-                 index, warm["buckets_warm"], warm["buckets_total"],
-                 warm["seconds"])
+        log.info("fleet worker %d ready: %d/%d bucket(s) warm in %.2fs "
+                 "(risk %s)", index, warm["buckets_warm"],
+                 warm["buckets_total"], warm["seconds"],
+                 service.risk_status())
 
     drained = threading.Event()
     R.install_signal_drain(lambda signum: drained.set())
+
+    if lease is not None and cfg.risk.index_path:
+        # the risk index loads in the background; republish the lease the
+        # moment its status terminalizes (ok | failed) so the supervisor's
+        # /check routing and fleet health never act on a stale "loading".
+        # Readiness is deliberately NOT gated on it — a failed index load
+        # degrades to scoring-disabled, never a worker that won't serve.
+        def _sync_risk_lease() -> None:
+            while not service.wait_risk_ready(timeout=1.0):
+                if drained.is_set():
+                    return
+            lease.risk = service.risk_status()
+            write_lease(paths, lease)
+            log.info("fleet worker %d risk index: %s", index,
+                     service.risk_status())
+
+        threading.Thread(target=_sync_risk_lease, daemon=True,
+                         name="risk-lease-sync").start()
     # unbounded BY DESIGN: the main thread's only job is to sleep until the
     # signal handler fires — there is no peer or producer that could wedge
     # this wait, and any deadline would just turn an idle server into a

@@ -253,6 +253,40 @@ class WarmCacheConfig:
 
 
 @dataclass
+class RiskConfig:
+    """Online copy-risk scoring (dcr_tpu/obs/copyrisk.py): SSCD gen↔train
+    similarity — the papers' headline replication measurement — computed
+    LIVE against a train-set embedding index instead of in offline eval
+    batch jobs. With ``index_path`` set, the serve worker scores every
+    generated batch (``copy_risk`` on each /generate response, ``POST
+    /check`` for ad-hoc queries, ``dcr_copy_risk_*`` telemetry, bounded
+    evidence dumps over ``threshold``) and the trainer scores its periodic
+    sample grids into ``risk/*`` MetricWriter gauges. A failed index load
+    degrades to scoring-disabled — it never blocks admission or training.
+    """
+
+    # train-set embedding dump: search/embed.py .npz format, or the
+    # reference toolchain's pickle {'features','indexes'} ("" = disabled)
+    index_path: str = ""
+    # SSCD backbone weights (torch state dict / TorchScript archive,
+    # converted on load). "" = deterministic random init — self-consistent
+    # (an index embedded with the same init scores correctly) but NOT
+    # comparable to reference SSCD numbers.
+    weights_path: str = ""
+    # max_sim >= threshold flags the generation as a probable copy. 0.5 is
+    # the papers' SSCD replication threshold ("Diffusion Art or Digital
+    # Forgery?" §4); raise it for random-init smoke indexes where the
+    # background similarity of unrelated images is higher.
+    threshold: float = 0.5
+    top_k: int = 1            # nearest train keys kept per generation
+    image_size: int = 224     # SSCD input crop (the embedding dump must match)
+    # flagged-generation evidence dumps (image + nearest train key), bounded
+    # per process; "" = <logdir>/risk_evidence when a logdir exists
+    evidence_dir: str = ""
+    max_evidence: int = 32    # 0 disables evidence dumps
+
+
+@dataclass
 class OptimConfig:
     learning_rate: float = 5e-6
     adam_beta1: float = 0.9
@@ -300,6 +334,7 @@ class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
+    risk: RiskConfig = field(default_factory=RiskConfig)
 
 
 @dataclass
@@ -412,6 +447,7 @@ class ServeConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
+    risk: RiskConfig = field(default_factory=RiskConfig)
 
 
 def validate_serve_config(cfg: ServeConfig) -> None:
@@ -450,6 +486,19 @@ def validate_serve_config(cfg: ServeConfig) -> None:
             raise ValueError("fleet.scrape_period_s and fleet.scrape_timeout_s"
                              " must be > 0 (an unbounded scrape turns a dead "
                              "worker into a hung /metrics)")
+    validate_risk_config(cfg.risk)
+
+
+def validate_risk_config(r: RiskConfig) -> None:
+    if r.top_k < 1:
+        raise ValueError("risk.top_k must be >= 1")
+    if r.image_size < 16:
+        raise ValueError("risk.image_size must be >= 16 (the SSCD backbone "
+                         "downsamples 32x; tiny crops degenerate)")
+    if not r.threshold == r.threshold:   # NaN compares unequal to itself
+        raise ValueError("risk.threshold must be a number, not NaN")
+    if r.max_evidence < 0:
+        raise ValueError("risk.max_evidence must be >= 0")
 
 
 @dataclass
@@ -659,6 +708,7 @@ def validate_train_config(cfg: TrainConfig) -> None:
     if d.trainspecial != "none" and d.class_prompt != "instancelevel_blip":
         # caption mitigations are blip-captions-only (reference diff_train.py:741-743)
         raise ValueError("trainspecial mitigations require class_prompt=instancelevel_blip")
+    validate_risk_config(cfg.risk)
     if cfg.model.seq_parallel_mode not in ("ring", "ulysses"):
         raise ValueError("seq_parallel_mode must be 'ring' or 'ulysses'")
     ft = cfg.fault

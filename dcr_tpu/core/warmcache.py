@@ -90,11 +90,13 @@ def _sha(data: bytes | str) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
-def _quarantine_rename(path: Path) -> Optional[Path]:
+def quarantine_rename(path: Path) -> Optional[Path]:
     """Rename a bad file out of its addressable name
     (``<name>.quarantined.<pid>.<ts>``); None when the rename itself fails
     (racing quarantiners / an entry already rewritten) — callers still log
-    and count the degraded load either way."""
+    and count the degraded load either way. Public: the copy-risk index
+    (obs/copyrisk.py) applies the same verify-before-load discipline to
+    embedding dumps."""
     dest = path.with_name(
         f"{path.name}.quarantined.{os.getpid()}.{int(time.time())}")
     try:
@@ -430,7 +432,7 @@ class WarmCache:
                     detail: str) -> None:
         """Rename a bad entry out of the key space (so it can't poison the
         next load) and make the recovery auditable."""
-        dest = _quarantine_rename(path)
+        dest = quarantine_rename(path)
         R.log_event("warmcache_quarantined", surface=surface, kind=kind,
                     detail=detail, entry=str(path),
                     quarantined_to=str(dest) if dest else None)
@@ -603,7 +605,7 @@ def read_warm_manifest(cache_dir: str | Path) -> list:
             raise ValueError(f"entries is {type(entries).__name__}, not list")
         return entries
     except (KeyError, ValueError, TypeError) as e:
-        dest = _quarantine_rename(path)
+        dest = quarantine_rename(path)
         R.log_event("warm_manifest_corrupt", error=repr(e), path=str(path),
                     quarantined_to=str(dest) if dest else None)
         R.bump_counter("warmcache/manifest_corrupt")

@@ -97,6 +97,64 @@ def make_sample_hook(*, num_inference_steps: int = 20, images_per_prompt: int = 
             out.mkdir(parents=True, exist_ok=True)
             grid.save(out / f"step_{step}.png")
             log.info("sample grid -> %s", out / f"step_{step}.png")
+            score_sample_grid(trainer, state, step, images)
 
     hook.state = state             # inspectable by callers/tests
     return hook
+
+
+def score_sample_grid(trainer, state: dict, step: int, images) -> None:
+    """dcr-watch: score one save interval's generations against the
+    configured train-embedding index (``TrainConfig.risk.index_path``) and
+    emit ``risk/*`` gauges through MetricWriter — the papers'
+    duplication→copying effect appears LIVE on the loss-curve timeline
+    instead of in a post-hoc eval job.
+
+    Called on the PRIMARY only (the index scores on a local 1-device mesh,
+    so there is no collective to diverge on); the index is memoized in the
+    hook's ``state``; every failure — bad dump, scoring error — degrades to
+    unscored grids with a ``copy_risk/*`` counter, never a failed step.
+    ``trainer`` only needs ``.cfg`` and ``.writer`` (stub-testable).
+    """
+    cfg = trainer.cfg
+    rcfg = getattr(cfg, "risk", None)
+    if rcfg is None or not rcfg.index_path:
+        return
+    from dcr_tpu.core import resilience as R
+    from dcr_tpu.core import tracing
+
+    if "risk_index" not in state:
+        from dcr_tpu.obs.copyrisk import CopyRiskIndex
+
+        try:
+            state["risk_index"] = CopyRiskIndex.load(
+                rcfg, batch=len(images), warm_dir=cfg.warm.dir)
+        except Exception as e:
+            R.log_event("risk_index_load_failed", path=rcfg.index_path,
+                        error=repr(e))
+            R.bump_counter("copy_risk/index_load_failed")
+            state["risk_index"] = None
+    index = state["risk_index"]
+    if index is None:
+        return
+    from dcr_tpu.obs import copyrisk
+
+    try:
+        with tracing.span("risk/score", step=step, batch=len(images)) as sp:
+            scores = index.score_batch(images)
+            agg = copyrisk.observe_scores(scores, rcfg.threshold)
+            sp.attrs.update(sims=[round(s.max_sim, 6) for s in scores],
+                            flagged=agg["flagged"])
+    except Exception as e:
+        R.log_event("risk_score_failed", step=step, error=repr(e))
+        R.bump_counter("copy_risk/score_failed")
+        return
+    trainer.writer.scalars(step, {
+        "risk/max_sim": agg["max_sim"],
+        "risk/mean_sim": agg["mean_sim"],
+        "risk/flagged": agg["flagged"],
+        "risk/scored": agg["scored"],
+    })
+    log.info("risk: step %d — max_sim %.4f, %d/%d over threshold %.3f",
+             step, agg["max_sim"], agg["flagged"], agg["scored"],
+             rcfg.threshold)

@@ -73,6 +73,28 @@ def ranked_galleries(query_paths: Sequence, train_paths: Sequence,
     return pages
 
 
+def flagged_pair_gallery(flag_paths: Sequence, match_paths: Sequence,
+                         sims: Sequence[float], out_dir: str | Path, *,
+                         thumb: int = 128, rows_per_page: int = 10
+                         ) -> list[Path]:
+    """dcr-watch evidence gallery: rows of [flagged generation | nearest
+    train match], ordered by descending similarity. The degenerate top-1
+    case of :func:`ranked_galleries` (identity match indices), so the
+    sort/thumbnail/row/paging machinery exists exactly once; used by
+    tools/risk_report.py to render serve evidence dumps as the same kind
+    of artifact the offline eval galleries produce."""
+    if not (len(flag_paths) == len(match_paths) == len(sims)):
+        raise ValueError(
+            f"flagged-pair gallery needs aligned lists, got "
+            f"{len(flag_paths)}/{len(match_paths)}/{len(sims)}")
+    if not flag_paths:
+        raise ValueError("no flagged pairs to render")
+    return ranked_galleries(
+        flag_paths, match_paths, np.asarray(sims, dtype=float),
+        np.arange(len(flag_paths))[:, None], out_dir,
+        rows_per_page=rows_per_page, max_rank=len(flag_paths), thumb=thumb)
+
+
 def image_grid(images: Sequence[np.ndarray], cols: int) -> Image.Image:
     """Grid from float [0,1] arrays — the trainer's periodic sample grids
     (reference diff_train.py:673-701 uses the missing concat_h for this)."""

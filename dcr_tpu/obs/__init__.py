@@ -1,0 +1,8 @@
+"""Scientific observability: live measurements of the paper's quantities.
+
+The telemetry spine (core/tracing.py, PR 5/8) watches *systems* — spans,
+queue depth, p99. This package watches the *science*: online SSCD
+copy-risk scoring (:mod:`dcr_tpu.obs.copyrisk`) makes the papers' headline
+replication measurement a first-class, continuously monitored metric in
+serve and training instead of a post-hoc eval batch job.
+"""

@@ -21,10 +21,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcr_tpu.core import tracing
+from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import SearchConfig
 from dcr_tpu.search.embed import find_embedding_file, load_embeddings
 
 log = logging.getLogger("dcr_tpu")
+
+
+@compile_surface("search/matmul")
+def make_search_matmul():
+    """Jitted ``(gen_chunk [M, D], laion_feats [N, D]) -> sims [M, N]`` —
+    the chunked brute-force similarity kernel. Registered so DCR010 and the
+    compile-surface manifest cover the search workload's one device
+    program (it was a bare ``jax.jit(lambda ...)`` before dcr-watch)."""
+    return jax.jit(lambda a, b: a @ b.T)
 
 
 def topk_merge(scores: np.ndarray, keys: np.ndarray, new_scores: np.ndarray,
@@ -54,8 +65,9 @@ def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
     best_scores = np.full((n, top_k), -np.inf, np.float32)
     best_keys = np.full((n, top_k), "", dtype=object)
 
-    matmul = jax.jit(lambda a, b: a @ b.T)
+    matmul = make_search_matmul()
 
+    folders_done = tracing.registry().counter("search/folders_done")
     for folder in laion_folders:
         emb_file = find_embedding_file(folder)
         if emb_file is None:
@@ -73,22 +85,29 @@ def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
         feats_j = jnp.asarray(feats)
         for start in range(0, n, chunk_size):
             gen_chunk = jnp.asarray(gen_features[start:start + chunk_size])
-            sims = np.asarray(jax.device_get(matmul(gen_chunk, feats_j)))
-            k = min(top_k, sims.shape[1])
-            top_idx = np.argpartition(-sims, k - 1, axis=1)[:, :k]
-            top_scores = np.take_along_axis(sims, top_idx, axis=1)
-            order = np.argsort(-top_scores, axis=1)
-            top_idx = np.take_along_axis(top_idx, order, axis=1)
-            top_scores = np.take_along_axis(top_scores, order, axis=1)
-            if k < top_k:  # pad tiny chunks
-                pad = top_k - k
-                top_scores = np.pad(top_scores, ((0, 0), (0, pad)),
-                                    constant_values=-np.inf)
-                top_idx = np.pad(top_idx, ((0, 0), (0, pad)))
-            sl = slice(start, start + len(top_scores))
-            best_scores[sl], best_keys[sl] = topk_merge(
-                best_scores[sl], best_keys[sl],
-                top_scores, keys_arr[top_idx])
+            # one span per device matmul + host top-k merge: the search
+            # stage's time breakdown in trace_report comes from here (it
+            # previously had only a per-folder log line + time.time())
+            with tracing.span("search/chunk", folder=str(folder),
+                              start=start, rows=int(gen_chunk.shape[0]),
+                              index_size=int(feats_j.shape[0])):
+                sims = np.asarray(jax.device_get(matmul(gen_chunk, feats_j)))
+                k = min(top_k, sims.shape[1])
+                top_idx = np.argpartition(-sims, k - 1, axis=1)[:, :k]
+                top_scores = np.take_along_axis(sims, top_idx, axis=1)
+                order = np.argsort(-top_scores, axis=1)
+                top_idx = np.take_along_axis(top_idx, order, axis=1)
+                top_scores = np.take_along_axis(top_scores, order, axis=1)
+                if k < top_k:  # pad tiny chunks
+                    pad = top_k - k
+                    top_scores = np.pad(top_scores, ((0, 0), (0, pad)),
+                                        constant_values=-np.inf)
+                    top_idx = np.pad(top_idx, ((0, 0), (0, pad)))
+                sl = slice(start, start + len(top_scores))
+                best_scores[sl], best_keys[sl] = topk_merge(
+                    best_scores[sl], best_keys[sl],
+                    top_scores, keys_arr[top_idx])
+        folders_done.inc()
         log.info("searched %s (%d embeddings) in %.1fs", folder, len(feats),
                  time.time() - t0)
     return {"scores": best_scores, "keys": best_keys,

@@ -113,6 +113,12 @@ class WorkerLease:
     ready: bool = True
     buckets_warm: int = -1    # -1 = not reported
     buckets_total: int = -1
+    # copy-risk index state (dcr-watch): absent | loading | ok | failed.
+    # Rides the lease so the supervisor can (a) surface a worker whose
+    # index load FAILED — it serves unscored, which must be visible, not
+    # silent — and (b) route POST /check only to workers that can answer.
+    # The default keeps pre-dcr-watch leases parseable.
+    risk: str = "absent"
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (now if now is not None else time.time()) \

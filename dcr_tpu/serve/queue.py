@@ -93,6 +93,10 @@ class Request:
     future: Future = field(default_factory=Future)
     enqueued_at: float = 0.0          # time.monotonic, stamped on admission
     cache_hit: Optional[bool] = None  # filled by the worker
+    # copy-risk verdict (obs/copyrisk.RiskScore.doc), filled by the worker
+    # after the device step when a risk index is loaded; None = unscored
+    # (scoring disabled / still loading / scoring failed)
+    risk: Optional[dict] = None
     # tracing.SpanHandle for the serve/request root span (opened at
     # admission, ended when the future resolves); child spans — queue wait,
     # device step, respond — parent on its id, giving one span tree per

@@ -9,8 +9,14 @@ Endpoints (JSON in/out):
   (validated BEFORE any compile); 503 with ``{"error":
   "overloaded"|"draining"|"bucket_limit"}`` on typed admission rejection;
   504 when the request exceeds the configured wait bound.
-- ``GET /healthz`` — 200 ``{"status": "ok"|"draining"}`` (load balancers pull
-  a draining replica out of rotation before its port closes).
+- ``POST /check`` — copy-risk query: body ``{"image_png_b64": <base64>}``
+  scores one image against the configured train-embedding index (200 with
+  ``{max_sim, top_key, flagged, topk, threshold}``; 503 + risk status while
+  no index is loaded).
+- ``GET /healthz`` — 200 ``{"status": "ok"|"draining", ..., "risk":
+  "absent"|"loading"|"ok"|"failed"}`` (load balancers pull a draining
+  replica out of rotation before its port closes; the risk field makes a
+  worker serving unscored — failed index load — visible).
 - ``GET /metrics`` — the :meth:`GenerationService.status` document: queue
   depth, batch occupancy, cache hit rate, p50/p99 latency.
   ``GET /metrics?format=prometheus`` renders the process-wide telemetry
@@ -210,6 +216,9 @@ class ServeHandler(BaseHTTPRequestHandler):
             "width": int(result.shape[1]),
             "height": int(result.shape[0]),
             "cache_hit": bool(req.cache_hit),
+            # copy-risk verdict ({max_sim, top_key, flagged, topk}) when a
+            # train-embedding index is loaded; null = unscored
+            "copy_risk": req.risk,
             "latency_ms": None,  # client-side wall time is the honest number
         }
 
@@ -218,10 +227,47 @@ class ServeHandler(BaseHTTPRequestHandler):
             self._post_generate()
         elif self.path == "/generate_batch":
             self._post_generate_batch()
+        elif self.path == "/check":
+            self._post_check()
         elif self.path == "/debug/profile":
             self._post_profile()
         else:
             self._reply(404, {"error": f"no such endpoint {self.path!r}"})
+
+    def _post_check(self) -> None:
+        """Copy-risk query (ROADMAP item 5's online endpoint): score one
+        submitted image against the train-embedding index. Body
+        ``{"image_png_b64": <base64 image>}``; replies 200 with ``{max_sim,
+        top_key, flagged, topk, threshold, index_size}``, 503 + risk status
+        while no loaded index can serve (absent/loading/failed — a worker
+        that failed its index load is VISIBLE here, never a silent zero),
+        400 on an undecodable body. On a fleet supervisor the query routes
+        to the first ALIVE worker whose lease reports risk "ok"."""
+        from dcr_tpu.obs.copyrisk import RiskUnavailableError
+
+        check_fn = getattr(self.service, "check", None)
+        if not callable(check_fn):
+            self._reply(404, {"error": "copy-risk checking not supported"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            if not isinstance(body, dict):
+                raise ValueError("body must be a JSON object")
+        except (TypeError, ValueError, json.JSONDecodeError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+            return
+        try:
+            self._reply(200, check_fn(body))
+        except RiskUnavailableError as e:
+            self._reply(503, {"error": "risk_unavailable", "risk": e.status,
+                              "detail": str(e)})
+        except AdmissionError as e:
+            self._reply(*admission_response(e))
+        except (KeyError, TypeError, ValueError) as e:
+            self._reply(400, {"error": f"bad request: {e!r}"})
+        except Exception as e:
+            self._reply(500, {"error": f"check failed: {e!r}"})
 
     def _post_profile(self) -> None:
         """Arm an on-demand jax.profiler capture: on a worker, around its own

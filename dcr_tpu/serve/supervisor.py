@@ -140,6 +140,7 @@ class _WorkerSlot:
             "ready": self.state == ALIVE,
             "buckets_warm": lease.buckets_warm if lease is not None else None,
             "buckets_total": lease.buckets_total if lease is not None else None,
+            "risk": lease.risk if lease is not None else None,
         }
 
 
@@ -685,6 +686,78 @@ class FleetSupervisor:
             doc = {"armed": False, "error": repr(e)}
         return {**doc, "worker": target}
 
+    # -- copy-risk (dcr-watch) -----------------------------------------------
+
+    def risk_health(self) -> str:
+        """Fleet-level risk-index state for /healthz: "ok" once ANY alive
+        worker can score (POST /check routes there), "failed" when every
+        reporting worker failed its load — a fleet silently serving
+        unscored is exactly what this field makes visible. Only ALIVE
+        slots count, matching :meth:`check`'s routing filter exactly: a
+        warming worker whose background index load finished early must
+        not flip this to "ok" while /check still has nowhere to route."""
+        if not self.cfg.risk.index_path:
+            return "absent"
+        with self._lock:
+            statuses = [s.lease.risk for s in self._slots
+                        if s.state == ALIVE and s.lease is not None]
+        if "ok" in statuses:
+            return "ok"
+        if "loading" in statuses or not statuses:
+            return "loading"
+        return "failed"
+
+    def check(self, body: dict) -> dict:
+        """``POST /check`` routed to the first ALIVE worker whose lease
+        reports a loaded risk index; the reply carries the serving worker's
+        index. Raises RiskUnavailableError (503 + status) when no worker
+        can answer."""
+        from dcr_tpu.obs.copyrisk import RiskUnavailableError
+
+        status = self.risk_health()
+        with self._lock:
+            ready = [(s.index, s.lease) for s in self._slots
+                     if s.state == ALIVE and s.lease is not None
+                     and s.lease.risk == "ok"]
+        if not ready:
+            raise RiskUnavailableError(
+                f"no ALIVE worker with a loaded risk index "
+                f"(fleet risk: {status})", status=status)
+        last_err: Optional[BaseException] = None
+        for index, lease in ready:
+            try:
+                code, doc = _post_json(self.cfg.host, lease.port, "/check",
+                                       body,
+                                       self.cfg.fleet.dispatch_timeout_s)
+            except (OSError, ValueError, http.client.HTTPException) as e:
+                # the crash race the fleet is BUILT for: the chosen worker
+                # died between the lease read and the POST — fail over to
+                # the next ready lease instead of 500ing a query another
+                # worker can answer (the monitor reaps the dead one)
+                R.log_event("risk_check_transport_error", worker=index,
+                            error=repr(e))
+                R.bump_counter("fleet_check_transport_errors")
+                last_err = e
+                continue
+            if code == 400:
+                raise ValueError(str(doc.get("error", doc)))
+            if code == 503:
+                # the worker's own risk state regressed (e.g. restarted and
+                # reloading); stale-lease race — try the next ready worker
+                last_err = RiskUnavailableError(
+                    str(doc.get("detail", doc)),
+                    status=doc.get("risk", status))
+                continue
+            if code != 200:
+                raise RuntimeError(
+                    f"worker {index} rejected /check ({code}): {doc!r}")
+            return {**doc, "worker": index}
+        if isinstance(last_err, RiskUnavailableError):
+            raise last_err
+        raise RiskUnavailableError(
+            f"every risk-ready worker failed the check query "
+            f"(last: {last_err!r})", status=status)
+
     def _fail_fleet(self) -> None:
         """Every slot exhausted its respawn budget: fail pending work loudly
         and leave a post-mortem, instead of a healthy-looking port whose
@@ -916,6 +989,7 @@ class FleetSupervisor:
             "workers_total": len(self._slots),
             "buckets_warm": sum(max(0, l.buckets_warm) for l in leases),
             "buckets_total": sum(max(0, l.buckets_total) for l in leases),
+            "risk": self.risk_health(),
         }
 
     def begin_drain(self) -> None:

@@ -293,6 +293,21 @@ class GenerationService:
         self._encode_jit = make_text_encoder(stack.models)
         self._encode = self._encode_jit
         self._tok_fp = stack.tokenizer.fingerprint()
+        # copy-risk scoring (dcr-watch): the train-embedding index loads in
+        # the BACKGROUND — a multi-GB index (or its SSCD compile) must never
+        # delay the port or admission. Until it terminalizes, batches go
+        # unscored (copy_risk: null); a failed load degrades to
+        # scoring-disabled with a counter, never a dead worker.
+        self._risk = None
+        self._risk_status = "absent"
+        self._risk_done = threading.Event()
+        self._evidence = None
+        if cfg.risk.index_path:
+            self._risk_status = "loading"
+            threading.Thread(target=self._load_risk_index, daemon=True,
+                             name="risk-index-load").start()
+        else:
+            self._risk_done.set()
         self._uncond: Optional[np.ndarray] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -567,7 +582,7 @@ class GenerationService:
             warm = len(self._samplers)
         total = max(len(self._warm_plan or ()), warm)
         return {"status": self.health(), "buckets_warm": warm,
-                "buckets_total": total}
+                "buckets_total": total, "risk": self._risk_status}
 
     def _uncond_embedding(self) -> np.ndarray:
         if self._uncond is None:
@@ -585,6 +600,119 @@ class GenerationService:
             emb = np.asarray(self._encode(self.stack.params["text"], ids))[0]
             self.cache.put(key, emb)
         return emb
+
+    # -- copy-risk scoring (dcr-watch) ---------------------------------------
+
+    def _load_risk_index(self) -> None:
+        """Background loader: dump -> verified index -> compiled pipeline
+        (extractor + top-k scorer through warmcache). Flips risk status
+        loading -> ok|failed; /healthz and the fleet lease report it."""
+        from dcr_tpu.obs.copyrisk import CopyRiskIndex, EvidenceRecorder
+
+        cfg = self.cfg
+        try:
+            with R.stage("risk_index_load"):
+                index = CopyRiskIndex.load(cfg.risk, batch=cfg.max_batch,
+                                           warm_dir=cfg.warm.dir)
+        except Exception as e:
+            R.log_event("risk_index_load_failed", path=cfg.risk.index_path,
+                        error=repr(e))
+            R.bump_counter("copy_risk/index_load_failed")
+            self._risk_status = "failed"
+            self._risk_done.set()
+            return
+        ev_dir = cfg.risk.evidence_dir
+        if not ev_dir:
+            base = tracing.trace_dir()
+            ev_dir = str(base / "risk_evidence") if base is not None else ""
+        self._evidence = EvidenceRecorder(ev_dir or None,
+                                          cfg.risk.max_evidence)
+        self._risk = index
+        self._risk_status = "ok"
+        self._risk_done.set()
+        log.info("serve: copy-risk index ok — %d train embeddings from %s "
+                 "(threshold %.3f%s)", len(index), cfg.risk.index_path,
+                 cfg.risk.threshold,
+                 f", evidence -> {ev_dir}" if ev_dir else "")
+
+    def risk_status(self) -> str:
+        """absent | loading | ok | failed."""
+        return self._risk_status
+
+    def wait_risk_ready(self, timeout: float) -> bool:
+        """True once the index load terminalized (ok OR failed)."""
+        return self._risk_done.wait(timeout)
+
+    def _score_risk(self, requests: list[Request], images: np.ndarray,
+                    ids: list, traces: list) -> None:
+        """Score one finished batch against the train index: `copy_risk` on
+        each request, sim histogram + flagged counters, a `risk/flagged`
+        event and bounded evidence dump per over-threshold generation. Any
+        failure is counted and the batch ships unscored — scoring must
+        never fail generation."""
+        from dcr_tpu.obs import copyrisk
+
+        index = self._risk
+        if index is None:
+            return
+        rcfg = self.cfg.risk
+        try:
+            with tracing.span("serve/risk_score", batch=len(requests),
+                              request_ids=ids, trace_ids=traces) as sp:
+                scores = index.score_batch(images)
+                agg = copyrisk.observe_scores(scores, rcfg.threshold)
+                # per-row sims/prompts ride the span: tools/risk_report's
+                # per-prompt breakdown and trace_report's percentiles come
+                # from here
+                sp.attrs.update(
+                    sims=[round(s.max_sim, 6) for s in scores],
+                    prompts=[r.prompt for r in requests],
+                    flagged=agg["flagged"])
+        except Exception as e:
+            R.log_event("risk_score_failed", batch=len(requests),
+                        error=repr(e))
+            R.bump_counter("copy_risk/score_failed")
+            return
+        for req, score, img in zip(requests, scores, images):
+            req.risk = score.doc(rcfg.threshold)
+            if score.max_sim >= rcfg.threshold:
+                tracing.event("risk/flagged", trace=req.trace_id,
+                              request_id=req.id, seed=req.seed,
+                              prompt=req.prompt,
+                              max_sim=round(score.max_sim, 6),
+                              top_key=score.top_key,
+                              threshold=rcfg.threshold)
+                if self._evidence is not None:
+                    self._evidence.record(
+                        img, score, rcfg.threshold, request_id=req.id,
+                        prompt=req.prompt, seed=req.seed,
+                        bucket=list(tuple(req.bucket)), trace=req.trace_id)
+
+    def check(self, body: dict) -> dict:
+        """``POST /check``: score ONE submitted image against the train
+        index — ROADMAP item 5's online "is this a copy?" query. Body:
+        ``{"image_png_b64": <base64 image>}``. Raises RiskUnavailableError
+        (503) while the index is absent/loading/failed, ValueError (400) on
+        an undecodable body."""
+        from dcr_tpu.obs.copyrisk import (RiskUnavailableError,
+                                          decode_image_b64)
+
+        index = self._risk
+        if index is None:
+            raise RiskUnavailableError(
+                f"risk index is {self._risk_status} "
+                f"(index_path={self.cfg.risk.index_path!r})",
+                status=self._risk_status)
+        image = decode_image_b64(body)
+        with tracing.span("serve/risk_score", source="check", batch=1) as sp:
+            score = index.score_batch(image[None])[0]
+            sp.attrs.update(sims=[round(score.max_sim, 6)])
+        reg = tracing.registry()
+        reg.counter("copy_risk/checked_total").inc()
+        reg.histogram("copy_risk/sim").observe(score.max_sim)
+        return {**score.doc(self.cfg.risk.threshold),
+                "threshold": self.cfg.risk.threshold,
+                "index_size": len(index)}
 
     def execute(self, requests: list[Request]) -> np.ndarray:
         """Run one bucket-coherent batch; returns float32 [n, H, W, 3].
@@ -628,7 +756,12 @@ class GenerationService:
                 # the device work is actually done — real step time, not
                 # dispatch
                 images = np.asarray(fn(self.stack.params, cond, uncond, seeds))
-        return images[:n]
+        images = images[:n]
+        # copy-risk scoring runs on the HOST COPY after the device step:
+        # generation is already done, so images are bit-identical with
+        # scoring on or off
+        self._score_risk(requests, images, ids, traces)
+        return images
 
     # -- the drain loop ------------------------------------------------------
 
@@ -761,6 +894,9 @@ class GenerationService:
         d["queue_depth"] = self.queue.depth()
         d["draining"] = self.draining
         d["cache"] = self.cache.stats()
+        risk = self._risk
+        d["risk"] = {"status": self._risk_status,
+                     "index_size": len(risk) if risk is not None else 0}
         with self._samplers_lock:     # worker thread mutates concurrently
             d["compiled_buckets"] = [tuple(b) for b in self._samplers]
         return d

@@ -685,6 +685,8 @@ def test_repo_registered_surfaces_match_expectations():
         "sample/sampler": True,
         "eval/embed": True,
         "eval/clip_score": False,
+        "risk/score": True,         # dcr-watch online copy-risk top-k
+        "search/matmul": True,      # the LAION brute-force search kernel
     }
 
 

@@ -234,6 +234,38 @@ def _build_eval_embed() -> dict:
                 })
 
 
+def _build_risk_score() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import RiskConfig, ServeConfig
+    from dcr_tpu.obs.copyrisk import EMBED_DIM, make_risk_scorer
+
+    rcfg = RiskConfig()
+    batch = ServeConfig().max_batch     # serve scores at the bucket batch
+    index_n = 1024                      # representative index size
+    fn = make_risk_scorer(rcfg.top_k)
+    feats = jax.ShapeDtypeStruct((index_n, EMBED_DIM), jnp.float32)
+    q = jax.ShapeDtypeStruct((batch, EMBED_DIM), jnp.float32)
+    return dict(fn=fn, args=(feats, q),
+                static_config={"top_k": rcfg.top_k, "embed_dim": EMBED_DIM,
+                               "batch": batch, "index_size": index_n})
+
+
+def _build_search_matmul() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.obs.copyrisk import EMBED_DIM
+    from dcr_tpu.search.search import make_search_matmul
+
+    fn = make_search_matmul()
+    gen_chunk = jax.ShapeDtypeStruct((64, EMBED_DIM), jnp.float32)
+    laion = jax.ShapeDtypeStruct((4096, EMBED_DIM), jnp.float32)
+    return dict(fn=fn, args=(gen_chunk, laion),
+                static_config={"embed_dim": EMBED_DIM})
+
+
 SAMPLERS = ("ddim", "dpm++", "ddpm")
 
 SURFACES: tuple[SurfaceSpec, ...] = (
@@ -249,6 +281,10 @@ SURFACES: tuple[SurfaceSpec, ...] = (
                 _build_serve_encode),
     SurfaceSpec("eval/embed@default", "eval/embed", "default",
                 _build_eval_embed),
+    SurfaceSpec("risk/score@default", "risk/score", "default",
+                _build_risk_score),
+    SurfaceSpec("search/matmul@default", "search/matmul", "default",
+                _build_search_matmul),
 )
 
 

@@ -1,0 +1,221 @@
+"""risk_report: turn dcr-watch telemetry into a copy-risk answer sheet.
+
+    python -m tools.risk_report <path> [<path> ...] [--json]
+                                [--evidence DIR] [--gallery OUT_DIR]
+
+Paths are trace directories/files exactly as ``tools/trace_report`` takes
+them (a serve ``--logdir``, a fleet dir, a train ``output_dir`` — merged
+across processes). The report answers the questions the offline
+``diff_retrieval.py`` workflow answered post-hoc, but from LIVE telemetry:
+
+- **per-prompt risk breakdown** — requests grouped by prompt (the
+  ``prompts``/``sims`` attrs on ``serve/risk_score`` spans): count,
+  mean/max similarity, flagged count. The papers' effect — duplicated
+  training prompts replicate — shows up here as per-prompt max_sim;
+- **flagged-request timeline** — every ``risk/flagged`` event in order,
+  with the nearest train key;
+- **flagged-pair gallery** — when ``--evidence`` points at a serve
+  worker's evidence dump dir (default: ``<path>/risk_evidence`` when it
+  exists) and the dumped train keys resolve to image files, renders
+  [flagged generation | nearest train image] rows via
+  ``eval/gallery.flagged_pair_gallery`` (skipped with a note when PIL or
+  the key paths are unavailable — the textual report never depends on it).
+
+Stdlib-only for the report itself (trace loading is shared with
+``tools/trace_report``); the gallery lazily imports PIL. Exit codes match
+trace_report: 0 report produced, 1 no records, 2 schema violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools import trace_report as TR
+
+
+def per_prompt_breakdown(records: list[dict]) -> dict[str, dict]:
+    """prompt -> {count, mean_sim, max_sim, flagged} from the per-row
+    ``prompts``/``sims`` attrs serve/risk_score spans carry. Training
+    risk/score spans carry sims without prompts and are aggregated under
+    the pseudo-prompt ``<train sample grid>``."""
+    rows: dict[str, list[float]] = {}
+    flagged_by_prompt: dict[str, int] = {}
+    for r in records:
+        if r["ph"] != "X":
+            continue
+        if r["name"] == "serve/risk_score":
+            sims = r["args"].get("sims") or []
+            # /check queries carry no prompt; label them as what they are
+            fallback = ("<POST /check>" if r["args"].get("source") == "check"
+                        else "<unknown>")
+            prompts = r["args"].get("prompts") or [fallback] * len(sims)
+            for prompt, sim in zip(prompts, sims):
+                rows.setdefault(str(prompt), []).append(float(sim))
+        elif r["name"] == "risk/score":
+            for sim in r["args"].get("sims") or []:
+                rows.setdefault("<train sample grid>", []).append(float(sim))
+    for r in records:
+        if r["ph"] == "i" and r["name"] == "risk/flagged":
+            prompt = str(r["args"].get("prompt", "<unknown>"))
+            flagged_by_prompt[prompt] = flagged_by_prompt.get(prompt, 0) + 1
+    out = {}
+    for prompt, sims in sorted(rows.items(), key=lambda kv: -max(kv[1])):
+        out[prompt] = {
+            "count": len(sims),
+            "mean_sim": round(sum(sims) / len(sims), 6),
+            "max_sim": round(max(sims), 6),
+            "flagged": flagged_by_prompt.get(prompt, 0),
+        }
+    return out
+
+
+def load_evidence(evidence_dir: Path) -> list[dict]:
+    """Parse the serve worker's bounded evidence dumps
+    (``flagged_*.json`` + sibling image). Unreadable entries are reported,
+    not fatal."""
+    items = []
+    for path in sorted(evidence_dir.glob("flagged_*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, json.JSONDecodeError) as e:
+            items.append({"error": f"{path.name}: {e}", "path": str(path)})
+            continue
+        doc["path"] = str(path)
+        image = evidence_dir / str(doc.get("image", ""))
+        doc["image_path"] = str(image) if image.is_file() else None
+        items.append(doc)
+    return items
+
+
+def render_gallery(evidence: list[dict], out_dir: Path) -> tuple[list, str]:
+    """([pages], note). Pairs need both the dumped image and a resolvable
+    train-key path; PIL is imported lazily so the textual report runs on a
+    bare checkout."""
+    pairs = [(e["image_path"], e["top_key"], float(e["max_sim"]))
+             for e in evidence
+             if e.get("image_path") and e.get("top_key")
+             and Path(str(e["top_key"])).is_file()]
+    if not pairs:
+        return [], "no renderable pairs (missing images or train keys)"
+    try:
+        from dcr_tpu.eval.gallery import flagged_pair_gallery
+    except Exception as e:  # PIL/numpy absent on a bare checkout
+        return [], f"gallery skipped ({e!r})"
+    flags, matches, sims = zip(*pairs)
+    pages = flagged_pair_gallery(list(flags), list(matches), list(sims),
+                                 out_dir)
+    return [str(p) for p in pages], f"{len(pairs)} pair(s)"
+
+
+def build_report(records: list[dict], evidence_dir: Path | None) -> dict:
+    report = {
+        "copy_risk": TR.copy_risk_summary(records),
+        "per_prompt": per_prompt_breakdown(records),
+        "evidence": [],
+    }
+    if evidence_dir is not None and evidence_dir.is_dir():
+        report["evidence"] = load_evidence(evidence_dir)
+        report["evidence_dir"] = str(evidence_dir)
+    return report
+
+
+def render_text(report: dict, paths: list[Path]) -> str:
+    lines = [f"copy-risk report: {', '.join(map(str, paths))}"]
+    risk = report["copy_risk"]
+    if risk is None:
+        lines.append("  nothing scored (no serve/risk_score, risk/score or "
+                     "risk/flagged records — is risk.index_path configured?)")
+        return "\n".join(lines)
+    lines.append(f"  {risk['scored']} generation(s) scored, "
+                 f"{risk['flagged']} flagged — sim p50 {risk['sim_p50']}  "
+                 f"p90 {risk['sim_p90']}  p99 {risk['sim_p99']}  "
+                 f"max {risk['sim_max']}")
+    if report["per_prompt"]:
+        lines.append("\nper-prompt risk (desc max_sim):")
+        for prompt, row in report["per_prompt"].items():
+            flag = f"  FLAGGED x{row['flagged']}" if row["flagged"] else ""
+            lines.append(f"  {prompt[:48]:<48} x{row['count']:<5} "
+                         f"mean {row['mean_sim']:.4f}  "
+                         f"max {row['max_sim']:.4f}{flag}")
+    if risk["flagged_timeline"]:
+        lines.append("\nflagged-request timeline:")
+        for f in risk["flagged_timeline"]:
+            lines.append(f"  {f['time']} req {f['request_id']} "
+                         f"sim {f['max_sim']} -> {f['top_key']}")
+    ev = report["evidence"]
+    if ev:
+        lines.append(f"\nevidence dumps ({report.get('evidence_dir')}):")
+        for e in ev:
+            if "error" in e:
+                lines.append(f"  UNREADABLE {e['error']}")
+            else:
+                lines.append(f"  sim {e.get('max_sim')} req "
+                             f"{e.get('request_id')} {e.get('image')} -> "
+                             f"{e.get('top_key')}")
+    if report.get("gallery_pages"):
+        lines.append(f"gallery: {', '.join(report['gallery_pages'])} "
+                     f"({report.get('gallery_note')})")
+    elif report.get("gallery_note"):
+        lines.append(f"gallery: {report['gallery_note']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.risk_report",
+        description="Per-prompt copy-risk breakdown, flagged-request "
+                    "timeline, and evidence gallery from dcr-watch "
+                    "telemetry.")
+    ap.add_argument("paths", type=Path, nargs="+", metavar="PATH",
+                    help="trace directories/files (serve --logdir, fleet "
+                         "dir, train output_dir)")
+    ap.add_argument("--evidence", type=Path, default=None, metavar="DIR",
+                    help="evidence dump dir (default: <first path>/"
+                         "risk_evidence when present)")
+    ap.add_argument("--gallery", type=Path, default=None, metavar="OUT_DIR",
+                    help="also render a flagged-pair gallery into this "
+                         "directory (eval/gallery.flagged_pair_gallery "
+                         "pages, gallery_rank<a>_<b>.png)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    args = ap.parse_args(argv)
+
+    for p in args.paths:
+        if not p.is_dir() and not p.is_file():
+            print(f"risk_report: {p} is not a directory or file",
+                  file=sys.stderr)
+            return 1
+    schema = TR.load_schema()
+    records, errors, _ = TR.load_fleet(args.paths, schema)
+    if errors:
+        for e in errors[:20]:
+            print(f"risk_report: SCHEMA: {e}", file=sys.stderr)
+        print(f"risk_report: {len(errors)} invalid record(s)",
+              file=sys.stderr)
+        return 2
+    if not records:
+        print(f"risk_report: no trace records under "
+              f"{', '.join(map(str, args.paths))}", file=sys.stderr)
+        return 1
+    evidence_dir = args.evidence
+    if evidence_dir is None:
+        for p in args.paths:
+            candidate = (p if p.is_dir() else p.parent) / "risk_evidence"
+            if candidate.is_dir():
+                evidence_dir = candidate
+                break
+    report = build_report(records, evidence_dir)
+    if args.gallery is not None and report["evidence"]:
+        pages, note = render_gallery(report["evidence"], args.gallery)
+        report["gallery_pages"] = pages
+        report["gallery_note"] = note
+    print(json.dumps(report, indent=1) if args.json
+          else render_text(report, args.paths))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

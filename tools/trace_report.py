@@ -17,6 +17,10 @@ validated against the checked-in ``tools/trace_schema.json``. The report:
   recompile count per bucket (``serve/compile`` events);
 - fault timeline: every ``fault/*`` event in chronological order, plus any
   flight-recorder dumps present in the directory;
+- copy risk (dcr-watch): flagged-generation count, gen↔train similarity
+  percentiles (from ``serve/risk_score`` / ``risk/score`` span ``sims``),
+  the most-hit train keys, and a flagged-request timeline from
+  ``risk/flagged`` events;
 - fleet section (when spans carry distributed trace ids): per-file clock
   offsets anchored on supervisor ``fleet/dispatch`` ↔ worker
   ``serve/assemble`` pairs (a dispatch causally precedes its assemble, so a
@@ -226,9 +230,12 @@ _CATEGORIES = (
     ("train/step", "step"),
     ("ckpt/", "ckpt"),
     ("stage/eval", "eval"),
+    ("serve/risk_score", "risk"),
     ("serve/", "serve"),
     ("stage/", "stage"),
     ("train/", "train"),
+    ("risk/", "risk"),
+    ("search/", "search"),
 )
 
 
@@ -336,6 +343,46 @@ def fleet_summary(records: list[dict], meta: dict) -> dict | None:
     }
 
 
+def copy_risk_summary(records: list[dict]) -> dict | None:
+    """The "Copy risk" section (dcr-watch): similarity percentiles from the
+    per-row ``sims`` attr that ``serve/risk_score`` (serving) and
+    ``risk/score`` (training sample grids) spans carry, plus the flagged
+    timeline from ``risk/flagged`` events. None when nothing was scored —
+    pre-dcr-watch traces keep their old report shape."""
+    sims: list[float] = []
+    for r in records:
+        if r["ph"] == "X" and r["name"] in ("serve/risk_score", "risk/score"):
+            sims.extend(float(s) for s in (r["args"].get("sims") or []))
+    flagged = [r for r in records
+               if r["ph"] == "i" and r["name"] == "risk/flagged"]
+    if not sims and not flagged:
+        return None
+    sims_sorted = sorted(sims)
+    top_keys: dict[str, int] = {}
+    for e in flagged:
+        key = str(e["args"].get("top_key", "?"))
+        top_keys[key] = top_keys.get(key, 0) + 1
+    timeline = [{
+        "time": time.strftime("%H:%M:%S", time.localtime(e["ts"] / 1e6)),
+        "ts": e["ts"],
+        "request_id": e["args"].get("request_id"),
+        "max_sim": e["args"].get("max_sim"),
+        "top_key": e["args"].get("top_key"),
+        "prompt": e["args"].get("prompt"),
+    } for e in flagged]
+    return {
+        "scored": len(sims),
+        "flagged": len(flagged),
+        "sim_p50": round(_percentile(sims_sorted, 50), 6),
+        "sim_p90": round(_percentile(sims_sorted, 90), 6),
+        "sim_p99": round(_percentile(sims_sorted, 99), 6),
+        "sim_max": round(sims_sorted[-1], 6) if sims_sorted else 0.0,
+        "flagged_train_keys": dict(sorted(top_keys.items(),
+                                          key=lambda kv: -kv[1])[:10]),
+        "flagged_timeline": timeline[:50],
+    }
+
+
 def compiles_per_incarnation(records: list[dict]) -> dict[str, int]:
     """XLA compiles per PROCESS INCARNATION — the recompile-budget unit.
 
@@ -426,6 +473,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "serve_queue_wait": queue_wait,
         "serve_recompiles_per_bucket": recompiles,
         "compiles_per_incarnation": compiles_per_incarnation(records),
+        "copy_risk": copy_risk_summary(records),
         "fault_timeline": faults,
         "fleet": fleet_summary(records, meta or {}),
     }
@@ -515,6 +563,17 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         lines.append("XLA compiles per process incarnation:")
         for inc, n in summary["compiles_per_incarnation"].items():
             lines.append(f"  {n}x {inc}")
+    risk = summary.get("copy_risk")
+    if risk:
+        lines.append(f"\ncopy risk: {risk['scored']} generation(s) scored, "
+                     f"{risk['flagged']} flagged — sim p50 {risk['sim_p50']}"
+                     f"  p90 {risk['sim_p90']}  p99 {risk['sim_p99']}"
+                     f"  max {risk['sim_max']}")
+        for key, count in risk["flagged_train_keys"].items():
+            lines.append(f"  {count}x nearest train key {key}")
+        for f in risk["flagged_timeline"][:10]:
+            lines.append(f"  {f['time']} FLAGGED req {f['request_id']} "
+                         f"sim {f['max_sim']} -> {f['top_key']}")
     if summary["fault_timeline"]:
         lines.append("\nfault timeline:")
         for f in summary["fault_timeline"]:
