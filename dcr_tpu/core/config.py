@@ -253,6 +253,31 @@ class WarmCacheConfig:
 
 
 @dataclass
+class FastSampleConfig:
+    """Training-free sampler acceleration (dcr_tpu/sampling/fastsample.py):
+    a host-computed per-step plan of ``full | reuse`` entries à la PFDiff —
+    full steps run the CFG UNet call and bank the guided score, reuse steps
+    skip the UNet and substitute the banked score (first-order reuse, or
+    second-order past-difference extrapolation once two scores are banked).
+    The plan is static config: each (bucket, plan) is its own compiled
+    program, and with ``enabled=False`` the samplers build their original
+    scan body bit-identically. Quality is gated by tools/bench_fastsample.py
+    (SSCD similarity + FID of fast-vs-reference output, banked as
+    BENCH_FASTSAMPLE.json).
+    """
+
+    enabled: bool = False
+    # fraction of steps replaced by score reuse; the effective denoiser-call
+    # reduction is ~1/(1-ratio) (0.5 => ~2x fewer UNet calls). Capped at
+    # fastsample.MAX_REUSE_RATIO (0.75); first two + final steps always full.
+    reuse_ratio: float = 0.5
+    # 1 = plain reuse of the last banked score; 2 = linear extrapolation
+    # from the last two (PFDiff's past-difference form) — strictly better
+    # fidelity at the same call count, the default.
+    order: int = 2
+
+
+@dataclass
 class RiskConfig:
     """Online copy-risk scoring (dcr_tpu/obs/copyrisk.py): SSCD gen↔train
     similarity — the papers' headline replication measurement — computed
@@ -357,6 +382,7 @@ class SampleConfig:
     rand_aug_repeats: int = 2              # reference diff_inference.py:218
     mesh: MeshConfig = field(default_factory=MeshConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
+    fast: FastSampleConfig = field(default_factory=FastSampleConfig)
 
 
 @dataclass
@@ -448,6 +474,10 @@ class ServeConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
     risk: RiskConfig = field(default_factory=RiskConfig)
+    # fast default bucket: with fast.enabled the default GenBucket carries
+    # the reuse plan (per-request overrides can still request a dense or
+    # differently-planned bucket within the compiled-bucket budget)
+    fast: FastSampleConfig = field(default_factory=FastSampleConfig)
 
 
 def validate_serve_config(cfg: ServeConfig) -> None:
@@ -487,6 +517,18 @@ def validate_serve_config(cfg: ServeConfig) -> None:
                              " must be > 0 (an unbounded scrape turns a dead "
                              "worker into a hung /metrics)")
     validate_risk_config(cfg.risk)
+    validate_fast_config(cfg.fast)
+
+
+def validate_fast_config(f: FastSampleConfig) -> None:
+    from dcr_tpu.sampling.fastsample import MAX_REUSE_RATIO
+
+    if not 0.0 <= f.reuse_ratio <= MAX_REUSE_RATIO:
+        raise ValueError(
+            f"fast.reuse_ratio must be in [0, {MAX_REUSE_RATIO}], "
+            f"got {f.reuse_ratio}")
+    if f.order not in (1, 2):
+        raise ValueError(f"fast.order must be 1 or 2, got {f.order}")
 
 
 def validate_risk_config(r: RiskConfig) -> None:

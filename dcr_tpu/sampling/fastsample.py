@@ -1,0 +1,186 @@
+"""Training-free fast sampling: score reuse + step skipping inside the scan.
+
+PFDiff (arXiv:2408.08822) observes that a diffusion ODE solver's score
+evaluations change slowly along the trajectory, so past scores can stand in
+for the current one — and that the first-order error of doing so largely
+cancels inside higher-order solver updates. Just-in-Time (arXiv:2603.10744)
+makes the same bet spatially: slowly-changing activations are cached across
+steps instead of recomputed. This module is the temporal form for this
+repo's ``lax.scan`` samplers: a host-computed per-step **plan** of
+``full | reuse`` entries (like :func:`~dcr_tpu.sampling.sampler.
+sampler_grid`, pure static config) where
+
+- a **full** step runs the 2B-row CFG UNet call exactly as today and banks
+  the guided prediction + its timestep in the scan carry;
+- a **reuse** step skips the UNet entirely (``lax.cond`` — XLA executes one
+  branch, so the FLOPs are really saved) and substitutes the banked score:
+  first-order reuse when one score is banked, second-order past-difference
+  extrapolation ``ε̂(t) = ε_last + (ε_last − ε_prev)·(t − t_last)/(t_last −
+  t_prev)`` once two are.
+
+The solver update (:func:`~dcr_tpu.sampling.sampler.scheduler_step`) runs
+on EVERY step with whichever prediction it got, so dpm++'s second-order
+multistep state advances through skipped steps exactly as through full
+ones. The plan is batch-uniform static config — part of the serve
+:class:`~dcr_tpu.serve.queue.GenBucket` and the bulk ``SampleConfig`` — so
+each (bucket, fast-plan) is a distinct compiled program that flows through
+the compile manifest, the warm cache, and the recompile budget like every
+other surface, and the serve purity contract (alone-vs-mixed-batch
+bit-identity) is untouched: every row of a batch follows the same plan,
+and the reuse math is elementwise over the batch.
+
+With the plan all-``full`` (fast disabled, or ``reuse_ratio=0``) the
+samplers build their ORIGINAL scan body — not a degenerate fast body — so
+the disabled path is bit-identical to the pre-fast sampler by
+construction (tested in tests/test_fastsample.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+#: Hard cap on the reuse fraction a plan will accept: beyond this the bank
+#: goes stale enough that even second-order extrapolation drifts visibly,
+#: and the mandatory full steps (head + final) can no longer be honored at
+#: small step counts.
+MAX_REUSE_RATIO = 0.75
+
+#: Leading steps that always run full: step 0 has nothing banked, step 1
+#: banks the second score so second-order extrapolation is live from the
+#: first possible reuse step.
+_FULL_HEAD = 2
+
+
+def fast_plan(num_steps: int, reuse_ratio: float) -> tuple[bool, ...]:
+    """Per-step plan, ``True`` = full UNet call, ``False`` = score reuse.
+
+    Host-computed static config (the moral twin of ``sampler_grid``):
+    deterministic in (num_steps, reuse_ratio). Invariants:
+
+    - the first two steps and the final step are always full (nothing is
+      banked at step 0; a full final step pins the trajectory endpoint the
+      same way diffusers' ``lower_order_final`` does);
+    - ``round(reuse_ratio * num_steps)`` reuse steps, capped by the
+      eligible interior, spread evenly so the bank never goes stale in one
+      long run of skips;
+    - ``reuse_ratio <= 0`` or a trajectory too short to skip anything
+      (fewer than 4 steps) degrades to all-full — never an error.
+    """
+    if not 0.0 <= reuse_ratio <= MAX_REUSE_RATIO:
+        raise ValueError(
+            f"reuse_ratio must be in [0, {MAX_REUSE_RATIO}], got {reuse_ratio}")
+    plan = [True] * num_steps
+    eligible = list(range(_FULL_HEAD, num_steps - 1))
+    n_reuse = min(int(round(reuse_ratio * num_steps)), len(eligible))
+    if reuse_ratio <= 0.0 or n_reuse <= 0:
+        return tuple(plan)
+    m = len(eligible)
+    # floor((i + 0.5) * m / n) is strictly increasing for n <= m: evenly
+    # spread, no duplicates, deterministic
+    for i in range(n_reuse):
+        plan[eligible[int((i + 0.5) * m // n_reuse)]] = False
+    return tuple(plan)
+
+
+def unet_calls(plan: tuple[bool, ...]) -> int:
+    """Full (UNet-calling) steps in a plan."""
+    return sum(1 for full in plan if full)
+
+
+def is_dense(plan: tuple[bool, ...]) -> bool:
+    """True when the plan skips nothing — the samplers then build their
+    original scan body, keeping the disabled path bit-identical."""
+    return all(plan)
+
+
+def canonical_plan_params(steps: int, fast_ratio: float,
+                          fast_order: int) -> tuple[float, int]:
+    """Canonical ``(fast_ratio, fast_order)`` for a bucket/program identity.
+
+    Every parameterization whose PLAN is dense — ratio 0, a ratio that
+    rounds to zero skips, or a trajectory too short to skip (< 4 steps) —
+    builds the byte-identical original scan body, and ``fast_order`` only
+    enters the program on reuse steps. Mapping them all onto ``(0.0, 2)``
+    keeps one bucket identity / admission slot / compiled program /
+    executable-cache key per distinct program. Invalid values pass through
+    unchanged so validation still rejects them loudly."""
+    if (fast_order in (1, 2) and 0.0 <= fast_ratio <= MAX_REUSE_RATIO
+            and is_dense(fast_plan(steps, fast_ratio))):
+        return 0.0, 2
+    return fast_ratio, fast_order
+
+
+class ScoreBank(NamedTuple):
+    """Scan-carried past scores: the last two banked guided predictions and
+    their (float) timesteps. A NamedTuple of arrays — a pytree, so it rides
+    the ``lax.scan`` carry next to the latent and the dpm++ state."""
+
+    pred: jax.Array       # last banked prediction (post-CFG), x-shaped
+    prev_pred: jax.Array  # the one before it
+    t: jax.Array          # float32 scalar: timestep of ``pred``
+    prev_t: jax.Array     # float32 scalar: timestep of ``prev_pred``
+    count: jax.Array      # int32 scalar: how many scores were ever banked
+
+
+def bank_init(shape: tuple[int, ...], dtype=jnp.float32) -> ScoreBank:
+    return ScoreBank(pred=jnp.zeros(shape, dtype),
+                     prev_pred=jnp.zeros(shape, dtype),
+                     t=jnp.zeros((), jnp.float32),
+                     prev_t=jnp.zeros((), jnp.float32),
+                     count=jnp.zeros((), jnp.int32))
+
+
+def bank_update(bank: ScoreBank, pred: jax.Array, t: jax.Array) -> ScoreBank:
+    """Push a freshly computed prediction (a full step just ran)."""
+    return ScoreBank(pred=pred, prev_pred=bank.pred,
+                     t=jnp.asarray(t, jnp.float32), prev_t=bank.t,
+                     count=bank.count + 1)
+
+
+def reuse_score(bank: ScoreBank, t: jax.Array, order: int) -> jax.Array:
+    """The substitute prediction for a reuse step at timestep ``t``.
+
+    ``order`` is static config: 1 = plain reuse of the last banked score
+    (PFDiff's zeroth/first-order past reuse); 2 = past-difference linear
+    extrapolation once two scores are banked (runtime-gated on
+    ``bank.count`` — the first reuse step after a single full step still
+    gets plain reuse). The plan guarantees at least one full step ran
+    before any reuse step, so the bank is never empty here.
+    """
+    if order < 2:
+        return bank.pred
+    dt = bank.t - bank.prev_t
+    slope = (bank.pred - bank.prev_pred) / jnp.where(dt == 0.0, 1.0, dt)
+    extrap = bank.pred + slope * (jnp.asarray(t, jnp.float32) - bank.t)
+    return jnp.where(bank.count >= 2, extrap, bank.pred)
+
+
+def predict_or_reuse(plan: tuple[bool, ...], step_idx: jax.Array,
+                     t: jax.Array, bank: ScoreBank, order: int,
+                     full_fn) -> tuple[jax.Array, ScoreBank]:
+    """One plan dispatch inside the scan body.
+
+    ``full_fn() -> pred`` runs the real (UNet + CFG) prediction; it is
+    traced into the ``lax.cond`` full branch, so on a reuse step XLA
+    executes only the (cheap, elementwise) reuse branch — the denoiser
+    FLOPs are genuinely skipped at runtime, while the whole trajectory
+    stays one compiled scan. The plan tuple is baked in as a program
+    constant: a different plan is a different program.
+    """
+    flags = jnp.asarray(np.asarray(plan, dtype=bool))
+
+    def full(ops):
+        bank = ops
+        pred = full_fn()
+        return pred, bank_update(bank, pred, t)
+
+    def reuse(ops):
+        bank = ops
+        return reuse_score(bank, t, order), bank
+
+    return jax.lax.cond(flags[step_idx], full, reuse, bank)

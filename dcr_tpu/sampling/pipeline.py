@@ -14,6 +14,7 @@ config.json is read from the checkpoint dir.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 from pathlib import Path
@@ -24,6 +25,7 @@ import numpy as np
 from PIL import Image
 
 from dcr_tpu.core import dist
+from dcr_tpu.core import tracing
 from dcr_tpu.core.checkpoint import import_hf_layout
 from dcr_tpu.core.config import ModelConfig, SampleConfig, from_dict
 from dcr_tpu.core import rng as rngmod
@@ -35,6 +37,7 @@ from dcr_tpu.models.unet2d import UNet2DCondition
 from dcr_tpu.models.vae import AutoencoderKL
 from dcr_tpu.parallel import mesh as pmesh
 from dcr_tpu.parallel.sharding import params_sharding
+from dcr_tpu.sampling import fastsample
 from dcr_tpu.sampling.prompts import build_prompt_list, save_prompts
 from dcr_tpu.sampling.sampler import make_sampler
 
@@ -213,6 +216,15 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
     sampler = make_sampler(cfg, models, mesh)
     uncond_ids = tokenizer([""])[0]
     key = rngmod.root_key(cfg.seed)
+    # fast-sampling accounting (dcr-fast): static per config, so the
+    # denoiser-call reduction is known without touching the device. The
+    # canonical params fold every dense-degraded parameterization onto the
+    # true dense identity (one executable-cache key per distinct program).
+    fast_ratio, fast_order = fastsample.canonical_plan_params(
+        cfg.num_inference_steps,
+        cfg.fast.reuse_ratio if cfg.fast.enabled else 0.0, cfg.fast.order)
+    plan = fastsample.fast_plan(cfg.num_inference_steps, fast_ratio)
+    unet_calls = fastsample.unet_calls(plan)
 
     count = 0
     # fixed device batch (prompts_per_batch × im_batch, padded up to a multiple
@@ -241,6 +253,13 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
                 "rand_noise_lam": cfg.rand_noise_lam,
                 "im_batch": cfg.im_batch,
                 "device_batch": device_batch,
+                # the fast plan is baked into the program: a different plan
+                # must be a different executable-cache key — and the
+                # CANONICAL params above key every dense-degraded
+                # parameterization the same as the true dense run (no
+                # spurious warm-cache miss from an irrelevant knob)
+                "fast_ratio": fast_ratio,
+                "fast_order": fast_order,
             },
             cache=warmcache.WarmCache(cfg.warm.dir))
         log.info("bulk sampler %s via warm cache (%s) in %.2fs",
@@ -256,7 +275,19 @@ def generate(cfg: SampleConfig, *, modelstyle: str,
                 [ids, np.repeat(ids[-1:], device_batch - real, axis=0)])
         unc = np.broadcast_to(uncond_ids, ids.shape).copy()
         batch_key = rngmod.step_key(rngmod.stream_key(key, "sample"), start)
-        images = pmesh.to_host(sampler(params, ids, unc, batch_key))[:real]
+        # one sample/fast span per accelerated batch execution (args.batch
+        # = trajectories in it) feeds trace_report's "Fast sampling"
+        # section; dense runs keep their pre-fast trace shape
+        fast_span = (tracing.span("sample/fast",
+                                  steps=cfg.num_inference_steps,
+                                  unet_calls=unet_calls, batch=real,
+                                  fast_ratio=fast_ratio,
+                                  fast_order=fast_order,
+                                  sampler=cfg.sampler)
+                     if unet_calls < cfg.num_inference_steps
+                     else contextlib.nullcontext())
+        with fast_span:
+            images = pmesh.to_host(sampler(params, ids, unc, batch_key))[:real]
         if dist.is_primary():
             for img in images:
                 arr = (img * 255).round().astype(np.uint8)

@@ -20,12 +20,13 @@ import jax
 import jax.numpy as jnp
 
 from dcr_tpu.core.compile_surface import compile_surface
-from dcr_tpu.core.config import SampleConfig
+from dcr_tpu.core.config import SampleConfig, validate_fast_config
 from dcr_tpu.core import rng as rngmod
 from dcr_tpu.diffusion.train import DiffusionModels
 from dcr_tpu.models import schedulers as S
 from dcr_tpu.models.vae import vae_scale_factor
 from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.sampling import fastsample
 
 
 def encode_prompts(models: DiffusionModels, text_params, input_ids: jax.Array,
@@ -63,6 +64,21 @@ def sampler_grid(sampler: str, sched, num_inference_steps: int):
     final_prev = -1 if sampler == "ddpm" else 0
     prev_ts = jnp.concatenate([ts[1:], jnp.array([final_prev], ts.dtype)])
     return ts, prev_ts, num_inference_steps < 15
+
+
+def fast_plan_grid(sampler: str, sched, num_inference_steps: int,
+                   reuse_ratio: float = 0.0):
+    """:func:`sampler_grid` plus the fast-sampling step plan: ``(ts,
+    prev_ts, lower_order_final, plan)`` where ``plan[i]`` is True for a
+    full (UNet-calling) step and False for a score-reuse step
+    (:mod:`dcr_tpu.sampling.fastsample`). The timestep grids are EXACTLY
+    ``sampler_grid``'s — fast sampling skips score evaluations, never
+    solver steps' positions — so ``reuse_ratio=0`` returns the identical
+    grid with an all-full plan (tested)."""
+    ts, prev_ts, lower_order_final = sampler_grid(sampler, sched,
+                                                  num_inference_steps)
+    plan = fastsample.fast_plan(num_inference_steps, reuse_ratio)
+    return ts, prev_ts, lower_order_final, plan
 
 
 def scheduler_step(sampler: str, sched, pred: jax.Array, x: jax.Array,
@@ -112,9 +128,18 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
     guidance = cfg.guidance_scale
     batch_spec = pmesh.batch_sharding(mesh)
 
-    # host-precomputed timestep grid [T] plus prev grid (see sampler_grid)
-    ts, prev_ts, lower_order_final = sampler_grid(cfg.sampler, sched,
-                                                  cfg.num_inference_steps)
+    # bad fast knobs fail HERE, loudly and typed — the serve path gets this
+    # from validate_bucket, and an invalid order must never silently run as
+    # a different order (reuse_score treats order<2 as plain reuse)
+    validate_fast_config(cfg.fast)
+    # host-precomputed timestep grid [T] + fast step plan (see fast_plan_grid;
+    # all-full unless cfg.fast enables score reuse)
+    reuse_ratio = cfg.fast.reuse_ratio if cfg.fast.enabled else 0.0
+    ts, prev_ts, lower_order_final, plan = fast_plan_grid(
+        cfg.sampler, sched, cfg.num_inference_steps, reuse_ratio)
+    # dense plan => build the ORIGINAL scan body (no cond, no score bank in
+    # the carry): the fast-disabled program is bit-identical by construction
+    use_fast = not fastsample.is_dense(plan)
 
     def sample_fn(params, input_ids, uncond_ids, key):
         input_ids = jax.lax.with_sharding_constraint(input_ids, batch_spec)
@@ -128,24 +153,39 @@ def make_sampler(cfg: SampleConfig, models: DiffusionModels, mesh):
         # (diffusers scales initial noise by init_noise_sigma = 1 for DDPM-family)
 
         def denoise(carry, step_idx):
-            x, dpm_state = carry
+            if use_fast:
+                x, dpm_state, bank = carry
+            else:
+                x, dpm_state = carry
             t = ts[step_idx]
             prev_t = prev_ts[step_idx]
-            tb = jnp.full((2 * bsz,), t, jnp.int32)
-            pred = models.unet.apply({"params": params["unet"]},
-                                     jnp.concatenate([x, x], axis=0), tb, ctx)
-            pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
-            pred = pred_uncond + guidance * (pred_cond - pred_uncond)
+
+            def predict():
+                tb = jnp.full((2 * bsz,), t, jnp.int32)
+                pred = models.unet.apply({"params": params["unet"]},
+                                         jnp.concatenate([x, x], axis=0), tb, ctx)
+                pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
+                return pred_uncond + guidance * (pred_cond - pred_uncond)
+
+            if use_fast:
+                pred, bank = fastsample.predict_or_reuse(
+                    plan, step_idx, t, bank, cfg.fast.order, predict)
+            else:
+                pred = predict()
             force1 = jnp.logical_and(lower_order_final,
                                      step_idx == len(ts) - 1)
             x_new, dpm_new = scheduler_step(
                 cfg.sampler, sched, pred, x, t, prev_t, dpm_state,
                 force_first_order=force1,
                 noise_key=jax.random.fold_in(ks, step_idx))
+            if use_fast:
+                return (x_new, dpm_new, bank), ()
             return (x_new, dpm_new), ()
 
         init = (x, S.dpm_init_state(x.shape))
-        (x, _), _ = jax.lax.scan(denoise, init, jnp.arange(len(ts)))
+        if use_fast:
+            init = init + (fastsample.bank_init(x.shape),)
+        (x, *_), _ = jax.lax.scan(denoise, init, jnp.arange(len(ts)))
 
         images = models.vae.apply({"params": params["vae"]}, x / scaling,
                                   method=models.vae.decode)

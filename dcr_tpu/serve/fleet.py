@@ -523,8 +523,17 @@ class RequestJournal:
 
 
 def bucket_from_tuple(values: tuple | list) -> GenBucket:
-    """Inverse of ``tuple(bucket)`` for journal/wire round-trips."""
-    res, steps, guidance, sampler, lam = values
+    """Inverse of ``tuple(bucket)`` for journal/wire round-trips. Accepts
+    the pre-fast 5-element form too (warm manifests and journals written by
+    older incarnations): missing fast fields default to the dense plan —
+    exactly what those programs were."""
+    res, steps, guidance, sampler, lam, *fast = values
+    if fast and len(fast) != 2:
+        raise ValueError(f"bucket tuple has {len(values)} elements, "
+                         "expected 5 or 7")
+    fast_ratio, fast_order = fast or (0.0, 2)
     return GenBucket(resolution=int(res), steps=int(steps),
                      guidance=float(guidance), sampler=str(sampler),
-                     rand_noise_lam=float(lam))
+                     rand_noise_lam=float(lam),
+                     fast_ratio=float(fast_ratio),
+                     fast_order=int(fast_order))

@@ -69,13 +69,24 @@ class NoWorkersError(AdmissionError):
 class GenBucket(NamedTuple):
     """The static generation parameters one compiled sampler serves. Two
     requests batch together iff their buckets are equal — everything here is
-    baked into the jitted program as a Python constant."""
+    baked into the jitted program as a Python constant.
+
+    ``fast_ratio``/``fast_order`` select the training-free fast-sampling
+    plan (dcr_tpu/sampling/fastsample.py): the per-step full|reuse schedule
+    is derived from (steps, fast_ratio) on the host and baked into the
+    program, so a fast bucket is a DISTINCT compiled program and the plan
+    is batch-uniform by construction — the alone-vs-mixed-batch bit-identity
+    contract holds with fast sampling on. ``fast_ratio=0`` is the dense
+    (pre-fast, bit-identical) sampler. Defaults keep 5-field constructors
+    and old 5-element wire tuples meaning exactly what they used to."""
 
     resolution: int
     steps: int
     guidance: float
     sampler: str
     rand_noise_lam: float
+    fast_ratio: float = 0.0
+    fast_order: int = 2
 
 
 _req_ids = itertools.count(1)

@@ -46,16 +46,17 @@ import numpy as np
 
 from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig
+from dcr_tpu.sampling import fastsample
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket,
                                  InvalidRequestError, NoWorkersError,
                                  QueueFullError, SloShedError)
-from dcr_tpu.serve.worker import GenerationService
+from dcr_tpu.serve.worker import MAX_STEPS, GenerationService
 
 log = logging.getLogger("dcr_tpu")
 
 _ALLOWED_OVERRIDES = ("seed", "steps", "guidance", "sampler", "rand_noise_lam",
-                      "resolution")
+                      "resolution", "fast_ratio", "fast_order")
 
 # typed admission rejection -> (HTTP status, wire error tag). SloShedError
 # and NoWorkersError additionally carry a Retry-After hint so balancers and
@@ -103,12 +104,27 @@ def request_bucket(service: GenerationService, body: dict) -> GenBucket:
     if unknown:
         raise ValueError(f"unknown request fields {sorted(unknown)!r}")
     d = service.default_bucket()
+    steps = int(body.get("steps", d.steps))
+    if not 1 <= steps <= MAX_STEPS:
+        # bounds-checked BEFORE the canonical plan computation below, which
+        # is O(steps) on the host — a hostile steps value must stay a typed
+        # 400, never a giant allocation on the handler thread
+        raise ValueError(f"steps must be in [1, {MAX_STEPS}], got {steps}")
+    # every fast parameterization whose plan is dense maps onto ONE bucket
+    # identity: a redundant override cannot burn an admission slot or
+    # compile a twin of the dense program (invalid values pass through and
+    # are rejected by validate_bucket at admission)
+    fast_ratio, fast_order = fastsample.canonical_plan_params(
+        steps, float(body.get("fast_ratio", d.fast_ratio)),
+        int(body.get("fast_order", d.fast_order)))
     return GenBucket(
         resolution=int(body.get("resolution", d.resolution)),
-        steps=int(body.get("steps", d.steps)),
+        steps=steps,
         guidance=float(body.get("guidance", d.guidance)),
         sampler=str(body.get("sampler", d.sampler)),
         rand_noise_lam=float(body.get("rand_noise_lam", d.rand_noise_lam)),
+        fast_ratio=fast_ratio,
+        fast_order=fast_order,
     )
 
 

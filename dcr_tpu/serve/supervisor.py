@@ -63,6 +63,7 @@ from dcr_tpu.serve.fleet import (FleetPaths, RequestJournal, WorkerLease,
                                  clear_lease, fleet_paths, read_lease)
 from dcr_tpu.serve.scrape import (ScrapeCache, http_get_text, inject_labels,
                                   merge_expositions)
+from dcr_tpu.sampling import fastsample
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket, NoWorkersError,
                                  Request, RequestQueue, SloShedError)
@@ -142,6 +143,23 @@ class _WorkerSlot:
             "buckets_total": lease.buckets_total if lease is not None else None,
             "risk": lease.risk if lease is not None else None,
         }
+
+
+def wire_item(req: Request, bucket: GenBucket, attempt: int) -> dict:
+    """One ``/generate_batch`` wire item: prompt + seed + the FULL bucket
+    identity — every field, including the fast-sampling plan, so the worker
+    executes the supervisor's bucket rather than back-filling missing knobs
+    from its own default — plus the distributed trace context. The worker
+    side decodes it with ``server.request_bucket`` (round-trip pinned in
+    tests/test_fastsample.py)."""
+    return {"prompt": req.prompt, "seed": req.seed,
+            "resolution": bucket.resolution, "steps": bucket.steps,
+            "guidance": bucket.guidance, "sampler": bucket.sampler,
+            "rand_noise_lam": bucket.rand_noise_lam,
+            "fast_ratio": bucket.fast_ratio,
+            "fast_order": bucket.fast_order,
+            "trace": (tracing.wire_context(req.span, attempt)
+                      if req.span is not None else None)}
 
 
 class DispatchChannel:
@@ -235,12 +253,8 @@ class DispatchChannel:
         # request = one span tree across both processes — and a requeued
         # re-execution ships the same trace id with attempt+1, merging as a
         # sibling child of the same root
-        payload = {"requests": [
-            {"prompt": r.prompt, "seed": r.seed, "resolution": b.resolution,
-             "steps": b.steps, "guidance": b.guidance, "sampler": b.sampler,
-             "rand_noise_lam": b.rand_noise_lam,
-             "trace": (tracing.wire_context(r.span, attempts[r.id])
-                       if r.span is not None else None)} for r in send]}
+        payload = {"requests": [wire_item(r, b, attempts[r.id])
+                                for r in send]}
         ids = [r.id for r in send]
         with tracing.span("fleet/dispatch", worker=self.index,
                           batch=len(send), request_ids=ids,
@@ -831,9 +845,13 @@ class FleetSupervisor:
 
     def default_bucket(self) -> GenBucket:
         c = self.cfg
+        ratio, order = fastsample.canonical_plan_params(
+            c.num_inference_steps,
+            c.fast.reuse_ratio if c.fast.enabled else 0.0, c.fast.order)
         return GenBucket(resolution=c.resolution, steps=c.num_inference_steps,
                          guidance=c.guidance_scale, sampler=c.sampler,
-                         rand_noise_lam=c.rand_noise_lam)
+                         rand_noise_lam=c.rand_noise_lam,
+                         fast_ratio=ratio, fast_order=order)
 
     def _check_shed(self) -> None:
         f = self.cfg.fleet

@@ -22,6 +22,7 @@ core, HTTP-free so benches and tests drive it in-process:
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
 import signal
@@ -43,8 +44,9 @@ from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
 from dcr_tpu.models import schedulers as S
 from dcr_tpu.models.vae import vae_scale_factor
+from dcr_tpu.sampling import fastsample
 from dcr_tpu.sampling.pipeline import GenerationStack
-from dcr_tpu.sampling.sampler import sampler_grid, scheduler_step
+from dcr_tpu.sampling.sampler import fast_plan_grid, scheduler_step
 from dcr_tpu.serve.batcher import Batcher
 from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
@@ -80,6 +82,13 @@ def validate_bucket(bucket: GenBucket, *, vae_scale: int) -> None:
     if not 0.0 <= bucket.rand_noise_lam <= 10.0:
         raise InvalidRequestError(
             f"rand_noise_lam must be in [0, 10], got {bucket.rand_noise_lam}")
+    if not 0.0 <= bucket.fast_ratio <= fastsample.MAX_REUSE_RATIO:
+        raise InvalidRequestError(
+            f"fast_ratio must be in [0, {fastsample.MAX_REUSE_RATIO}], "
+            f"got {bucket.fast_ratio}")
+    if bucket.fast_order not in (1, 2):
+        raise InvalidRequestError(
+            f"fast_order must be 1 or 2, got {bucket.fast_order}")
 
 
 @compile_surface("serve/batch_sampler")
@@ -94,8 +103,11 @@ def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
     seeds[i]) — batch composition cannot perturb it.
     """
     sched = models.schedule
-    ts, prev_ts, lower_order_final = sampler_grid(bucket.sampler, sched,
-                                                  bucket.steps)
+    ts, prev_ts, lower_order_final, plan = fast_plan_grid(
+        bucket.sampler, sched, bucket.steps, bucket.fast_ratio)
+    # dense plan => the ORIGINAL scan body, bit-identical to the pre-fast
+    # sampler; a reuse plan is a distinct compiled program for this bucket
+    use_fast = not fastsample.is_dense(plan)
     latent_size = bucket.resolution // vae_scale_factor(models.vae.config)
     latent_ch = models.vae.config.vae_latent_channels
     scaling = models.vae.config.vae_scaling_factor
@@ -129,15 +141,30 @@ def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
         step_keys = jax.vmap(lambda k: rngmod.stream_key(k, "steps"))(keys)
 
         def denoise(carry, step_idx):
-            x, dpm_state = carry
+            if use_fast:
+                x, dpm_state, bank = carry
+            else:
+                x, dpm_state = carry
             t = ts[step_idx]
             prev_t = prev_ts[step_idx]
             bsz = x.shape[0]
-            tb = jnp.full((2 * bsz,), t, jnp.int32)
-            pred = models.unet.apply({"params": params["unet"]},
-                                     jnp.concatenate([x, x], axis=0), tb, ctx)
-            pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
-            pred = pred_uncond + guidance * (pred_cond - pred_uncond)
+
+            def predict():
+                tb = jnp.full((2 * bsz,), t, jnp.int32)
+                pred = models.unet.apply({"params": params["unet"]},
+                                         jnp.concatenate([x, x], axis=0), tb,
+                                         ctx)
+                pred_uncond, pred_cond = jnp.split(pred, 2, axis=0)
+                return pred_uncond + guidance * (pred_cond - pred_uncond)
+
+            if use_fast:
+                # elementwise over the batch, plan uniform per bucket: row
+                # i's reuse/extrapolation depends only on row i's banked
+                # scores, so batch-composition bit-independence survives
+                pred, bank = fastsample.predict_or_reuse(
+                    plan, step_idx, t, bank, bucket.fast_order, predict)
+            else:
+                pred = predict()
             if bucket.sampler == "ddpm":
                 # per-row keys via vmap: the ancestral noise of request i
                 # must not depend on batch position or neighbors (the bulk
@@ -154,10 +181,14 @@ def make_batch_sampler(bucket: GenBucket, models, root_seed: int,
                 x_new, dpm_new = scheduler_step(
                     bucket.sampler, sched, pred, x, t, prev_t, dpm_state,
                     force_first_order=force1)
+            if use_fast:
+                return (x_new, dpm_new, bank), ()
             return (x_new, dpm_new), ()
 
         init = (x, S.dpm_init_state(x.shape))
-        (x, _), _ = jax.lax.scan(denoise, init, jnp.arange(len(ts)))
+        if use_fast:
+            init = init + (fastsample.bank_init(x.shape),)
+        (x, *_), _ = jax.lax.scan(denoise, init, jnp.arange(len(ts)))
         images = models.vae.apply({"params": params["vae"]}, x / scaling,
                                   method=models.vae.decode)
         return jnp.clip(images * 0.5 + 0.5, 0.0, 1.0)
@@ -319,9 +350,13 @@ class GenerationService:
 
     def default_bucket(self) -> GenBucket:
         c = self.cfg
+        ratio, order = fastsample.canonical_plan_params(
+            c.num_inference_steps,
+            c.fast.reuse_ratio if c.fast.enabled else 0.0, c.fast.order)
         return GenBucket(resolution=c.resolution, steps=c.num_inference_steps,
                          guidance=c.guidance_scale, sampler=c.sampler,
-                         rand_noise_lam=c.rand_noise_lam)
+                         rand_noise_lam=c.rand_noise_lam,
+                         fast_ratio=ratio, fast_order=order)
 
     def submit(self, prompt: str, *, seed: int = 0,
                bucket: Optional[GenBucket] = None,
@@ -454,6 +489,10 @@ class GenerationService:
                 "guidance": bucket.guidance, "sampler": bucket.sampler,
                 "rand_noise_lam": bucket.rand_noise_lam,
                 "max_batch": self.cfg.max_batch,
+                # the fast plan is derived from these: a different plan is a
+                # different program, so it must be a different cache key
+                "fast_ratio": bucket.fast_ratio,
+                "fast_order": bucket.fast_order,
             },
             cache=self._warmcache)
         if res.source == "cache":
@@ -749,13 +788,28 @@ class GenerationService:
         # profiling.capture is a no-op unless /debug/profile (or the trainer's
         # DCR_PROFILE_AT_STEP) armed a jax.profiler window over the next K
         # device steps
+        # fast-sampling accounting: the plan is static per bucket, so the
+        # denoiser-call reduction is known on the host without touching the
+        # device. One sample/fast span per accelerated batch execution
+        # (args.batch = trajectories in it) feeds trace_report's "Fast
+        # sampling" section; dense-bucket traces keep their pre-fast shape.
+        plan = fastsample.fast_plan(bucket.steps, bucket.fast_ratio)
+        calls = fastsample.unet_calls(plan)
+        fast_span = (tracing.span("sample/fast", steps=bucket.steps,
+                                  unet_calls=calls, batch=n,
+                                  fast_ratio=bucket.fast_ratio,
+                                  fast_order=bucket.fast_order,
+                                  sampler=bucket.sampler)
+                     if calls < bucket.steps else contextlib.nullcontext())
         with profiling.capture():
             with tracing.span("serve/device_step", batch=n, request_ids=ids,
                               trace_ids=traces, bucket=str(tuple(bucket))):
-                # np.asarray forces the transfer, so this span closes only when
-                # the device work is actually done — real step time, not
-                # dispatch
-                images = np.asarray(fn(self.stack.params, cond, uncond, seeds))
+                with fast_span:
+                    # np.asarray forces the transfer, so these spans close
+                    # only when the device work is actually done — real
+                    # step time, not dispatch
+                    images = np.asarray(
+                        fn(self.stack.params, cond, uncond, seeds))
         images = images[:n]
         # copy-risk scoring runs on the HOST COPY after the device step:
         # generation is already done, so images are bit-identical with

@@ -697,17 +697,35 @@ def test_checked_in_manifest_covers_acceptance_surfaces():
     for e in entries.values():
         by_surface.setdefault(e["surface"], set()).add(e["variant"])
     # the acceptance list: train step, every default serve bucket sampler,
-    # both/all samplers, eval embed step
+    # both/all samplers (plus the dcr-fast score-reuse variants at the
+    # default operating point), eval embed step
     assert "default" in by_surface["train/step"]
-    assert by_surface["serve/batch_sampler"] == {"ddim", "dpm++", "ddpm"}
-    assert by_surface["sample/sampler"] == {"ddim", "dpm++", "ddpm"}
+    assert by_surface["serve/batch_sampler"] == {"ddim", "dpm++", "ddpm",
+                                                 "dpm++-fast"}
+    assert by_surface["sample/sampler"] == {"ddim", "dpm++", "ddpm",
+                                            "dpm++-fast"}
     assert "default" in by_surface["eval/embed"]
     for entry in entries.values():
         assert entry["lowered_sha256"] and entry["in_avals"]["leaves"] > 0
-        # every serve bucket records the default bucket's static knobs
+        # every serve bucket records the default bucket's static knobs —
+        # including the fast plan's, so a changed default operating point
+        # is a readable manifest diff
         if entry["surface"] == "serve/batch_sampler":
+            from dcr_tpu.core.config import FastSampleConfig
+
             assert entry["static_config"]["resolution"] == 256
             assert entry["static_config"]["steps"] == 50
+            assert entry["static_config"]["fast_order"] in (1, 2)
+            # the fast variant pins the FastSampleConfig DEFAULT operating
+            # point (the one bench_fastsample gates), dense variants 0
+            want_ratio = (FastSampleConfig().reuse_ratio
+                          if entry["variant"].endswith("-fast") else 0.0)
+            assert entry["static_config"]["fast_ratio"] == want_ratio
+    # a fast variant's program really differs from its dense twin
+    assert (entries["serve/batch_sampler@dpm++-fast"]["lowered_sha256"]
+            != entries["serve/batch_sampler@dpm++"]["lowered_sha256"])
+    assert (entries["sample/sampler@dpm++-fast"]["lowered_sha256"]
+            != entries["sample/sampler@dpm++"]["lowered_sha256"])
 
 
 def test_surface_specs_agree_with_registrations():

@@ -1,0 +1,520 @@
+"""dcr-fast acceptance: plan math, score-reuse semantics, bit-identity of
+the disabled path (bulk + serve), serve purity with fast on, trace/report
+plumbing, and the BENCH_FASTSAMPLE schema contract."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dcr_tpu.core import rng as rngmod
+from dcr_tpu.core.config import (FastSampleConfig, MeshConfig, ModelConfig,
+                                 SampleConfig, TrainConfig,
+                                 validate_fast_config)
+from dcr_tpu.data.tokenizer import HashTokenizer
+from dcr_tpu.diffusion.trainer import build_models
+from dcr_tpu.models import schedulers as S
+from dcr_tpu.parallel import mesh as pmesh
+from dcr_tpu.sampling import fastsample
+from dcr_tpu.sampling.sampler import (encode_prompts, fast_plan_grid,
+                                      make_sampler, sampler_grid)
+from dcr_tpu.serve.fleet import bucket_from_tuple
+from dcr_tpu.serve.queue import GenBucket, InvalidRequestError, Request
+from dcr_tpu.serve.worker import validate_bucket
+
+
+# ---------------------------------------------------------------------------
+# plan math (pure host, no compiles)
+# ---------------------------------------------------------------------------
+
+def test_fast_plan_invariants():
+    for steps in (4, 8, 16, 32, 50, 101):
+        for ratio in (0.0, 0.25, 0.5, 0.75):
+            plan = fastsample.fast_plan(steps, ratio)
+            assert len(plan) == steps
+            # first two and final step always full
+            assert plan[0] and plan[1] and plan[-1]
+            n_reuse = steps - fastsample.unet_calls(plan)
+            want = min(int(round(ratio * steps)), max(0, steps - 3))
+            assert n_reuse == want
+            # deterministic
+            assert plan == fastsample.fast_plan(steps, ratio)
+    # ratio 0 (or an infeasible trajectory) degrades to dense, never errors
+    assert fastsample.is_dense(fastsample.fast_plan(16, 0.0))
+    assert fastsample.is_dense(fastsample.fast_plan(3, 0.75))
+    assert fastsample.is_dense(fastsample.fast_plan(1, 0.5))
+    with pytest.raises(ValueError):
+        fastsample.fast_plan(16, 0.9)
+    with pytest.raises(ValueError):
+        fastsample.fast_plan(16, -0.1)
+
+
+def test_fast_plan_default_point_hits_acceptance_reduction():
+    # the ISSUE 12 floor: the default operating point (ratio 0.5) must save
+    # >= 1.8x denoiser calls at realistic step counts
+    for steps in (16, 32, 50):
+        plan = fastsample.fast_plan(steps, FastSampleConfig().reuse_ratio)
+        assert steps / fastsample.unet_calls(plan) >= 1.8
+
+
+def test_fast_plan_grid_ratio_zero_identical_to_sampler_grid():
+    # the satellite contract: a reuse plan with ratio 0 IS sampler_grid —
+    # same timestep grids, same lower-order flag, all-full plan
+    sched = S.make_schedule()
+    for sampler in ("ddim", "dpm++", "ddpm"):
+        for steps in (4, 12, 50):
+            ts, prev_ts, lof = sampler_grid(sampler, sched, steps)
+            fts, fprev, flof, plan = fast_plan_grid(sampler, sched, steps,
+                                                    0.0)
+            np.testing.assert_array_equal(np.asarray(ts), np.asarray(fts))
+            np.testing.assert_array_equal(np.asarray(prev_ts),
+                                          np.asarray(fprev))
+            assert lof == flof
+            assert plan == (True,) * steps
+    # and a reuse plan never moves the solver's timestep positions
+    ts, prev_ts, lof = sampler_grid("dpm++", sched, 20)
+    fts, fprev, flof, plan = fast_plan_grid("dpm++", sched, 20, 0.5)
+    np.testing.assert_array_equal(np.asarray(ts), np.asarray(fts))
+    np.testing.assert_array_equal(np.asarray(prev_ts), np.asarray(fprev))
+    assert not fastsample.is_dense(plan)
+
+
+def test_score_bank_reuse_and_extrapolation():
+    shape = (2, 3)
+    bank = fastsample.bank_init(shape)
+    assert int(bank.count) == 0
+    p1 = jnp.full(shape, 2.0)
+    bank = fastsample.bank_update(bank, p1, 100.0)
+    # one banked score: both orders fall back to plain reuse
+    np.testing.assert_array_equal(fastsample.reuse_score(bank, 80.0, 1), p1)
+    np.testing.assert_array_equal(fastsample.reuse_score(bank, 80.0, 2), p1)
+    p2 = jnp.full(shape, 3.0)
+    bank = fastsample.bank_update(bank, p2, 90.0)
+    assert int(bank.count) == 2
+    # order 1: still plain reuse of the last score
+    np.testing.assert_array_equal(fastsample.reuse_score(bank, 70.0, 1), p2)
+    # order 2: linear past-difference extrapolation in timestep space:
+    # slope = (3-2)/(90-100) = -0.1; at t=70: 3 + (-0.1)*(70-90) = 5.0
+    np.testing.assert_allclose(
+        np.asarray(fastsample.reuse_score(bank, 70.0, 2)),
+        np.full(shape, 5.0), rtol=1e-6)
+
+
+def test_validate_fast_config():
+    validate_fast_config(FastSampleConfig())
+    with pytest.raises(ValueError):
+        validate_fast_config(FastSampleConfig(reuse_ratio=0.9))
+    with pytest.raises(ValueError):
+        validate_fast_config(FastSampleConfig(order=3))
+
+
+def test_make_sampler_rejects_invalid_fast_config(tiny_models, cpu_devices):
+    # the bulk path must reject what serve's validate_bucket rejects: an
+    # invalid order silently running as a DIFFERENT order would mislabel
+    # every banked fidelity number
+    models, _ = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    with pytest.raises(ValueError):
+        make_sampler(_sample_cfg(fast=FastSampleConfig(
+            enabled=True, order=3)), models, mesh)
+    with pytest.raises(ValueError):
+        make_sampler(_sample_cfg(fast=FastSampleConfig(
+            enabled=True, reuse_ratio=0.9)), models, mesh)
+
+
+def test_canonical_plan_params_folds_dense_parameterizations():
+    # everything whose PLAN is dense is ONE identity: ratio 0 under any
+    # valid order, a ratio that rounds to zero skips, and a trajectory too
+    # short to skip — while reuse plans and invalid values pass through
+    assert fastsample.canonical_plan_params(50, 0.0, 1) == (0.0, 2)
+    assert fastsample.canonical_plan_params(50, 0.009, 1) == (0.0, 2)
+    assert fastsample.canonical_plan_params(3, 0.75, 1) == (0.0, 2)
+    assert fastsample.canonical_plan_params(50, 0.5, 1) == (0.5, 1)
+    assert fastsample.canonical_plan_params(50, 0.9, 1) == (0.9, 1)
+    assert fastsample.canonical_plan_params(50, 0.0, 7) == (0.0, 7)
+
+
+def test_validate_bucket_fast_fields():
+    def bucket(**kw):
+        d = dict(resolution=16, steps=4, guidance=7.5, sampler="ddim",
+                 rand_noise_lam=0.0)
+        d.update(kw)
+        return GenBucket(**d)
+
+    validate_bucket(bucket(fast_ratio=0.5), vae_scale=4)
+    with pytest.raises(InvalidRequestError):
+        validate_bucket(bucket(fast_ratio=0.9), vae_scale=4)
+    with pytest.raises(InvalidRequestError):
+        validate_bucket(bucket(fast_ratio=-0.1), vae_scale=4)
+    with pytest.raises(InvalidRequestError):
+        validate_bucket(bucket(fast_order=0), vae_scale=4)
+
+
+def test_bucket_tuple_roundtrip_and_legacy_five_tuple():
+    b = GenBucket(resolution=32, steps=8, guidance=5.0, sampler="dpm++",
+                  rand_noise_lam=0.1, fast_ratio=0.5, fast_order=1)
+    assert bucket_from_tuple(tuple(b)) == b
+    assert bucket_from_tuple(list(tuple(b))) == b
+    # a pre-fast 5-element wire tuple (old journal / warm manifest) decodes
+    # to the dense plan — exactly the program it named
+    legacy = bucket_from_tuple((32, 8, 5.0, "dpm++", 0.1))
+    assert legacy.fast_ratio == 0.0 and legacy.fast_order == 2
+    assert legacy[:5] == b[:5]
+    with pytest.raises(ValueError):
+        bucket_from_tuple((32, 8, 5.0, "dpm++", 0.1, 0.5))
+
+
+def test_serve_config_fast_maps_into_default_bucket():
+    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.serve import server
+    from dcr_tpu.serve.worker import GenerationService
+
+    class FakeService:
+        def __init__(self, cfg):
+            self.cfg = cfg
+        default_bucket = GenerationService.default_bucket
+
+    off = FakeService(ServeConfig()).default_bucket()
+    assert off.fast_ratio == 0.0
+    on = FakeService(ServeConfig(
+        fast=FastSampleConfig(enabled=True, reuse_ratio=0.25,
+                              order=1))).default_bucket()
+    assert on.fast_ratio == 0.25 and on.fast_order == 1
+    # per-request overrides reach the bucket (and unknown fields still 400)
+    svc = FakeService(ServeConfig())
+    b = server.request_bucket(svc, {"prompt": "x", "fast_ratio": 0.5,
+                                    "fast_order": 1})
+    assert b.fast_ratio == 0.5 and b.fast_order == 1
+    with pytest.raises(ValueError):
+        server.request_bucket(svc, {"prompt": "x", "fast_nope": 1})
+    # a hostile steps value is a typed 400 BEFORE the O(steps) canonical
+    # plan computation — never a giant allocation on the handler thread
+    with pytest.raises(ValueError):
+        server.request_bucket(svc, {"prompt": "x", "steps": 2_000_000_000})
+    with pytest.raises(ValueError):
+        server.request_bucket(svc, {"prompt": "x", "steps": 0})
+
+
+def test_fleet_dispatch_wire_round_trips_fast_fields():
+    """The supervisor's /generate_batch wire item must carry the FULL
+    bucket identity: a worker whose own default differs (e.g. a fast
+    fleet serving a client-pinned dense bucket, or vice versa) has to
+    execute the supervisor's plan, not silently back-fill its default."""
+    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.serve import server
+    from dcr_tpu.serve.supervisor import wire_item
+    from dcr_tpu.serve.worker import GenerationService
+
+    class FakeService:
+        def __init__(self, cfg):
+            self.cfg = cfg
+        default_bucket = GenerationService.default_bucket
+
+    sent = GenBucket(resolution=16, steps=8, guidance=7.5, sampler="ddim",
+                     rand_noise_lam=0.0, fast_ratio=0.5, fast_order=1)
+    req = Request(prompt="x", seed=3, bucket=sent)
+    item = wire_item(req, sent, attempt=1)
+    item.pop("trace")       # the handler pops it before bucket parsing
+    # worker whose OWN default is a fast bucket: the wire's dense/other
+    # plan must win
+    worker_default_fast = FakeService(ServeConfig(
+        resolution=16, num_inference_steps=8, sampler="ddim",
+        fast=FastSampleConfig(enabled=True, reuse_ratio=0.25)))
+    assert server.request_bucket(worker_default_fast, item) == sent
+    # and a dense wire bucket stays dense on that worker
+    dense = sent._replace(fast_ratio=0.0, fast_order=2)
+    item2 = wire_item(Request(prompt="x", seed=3, bucket=dense), dense, 1)
+    item2.pop("trace")
+    assert server.request_bucket(worker_default_fast, item2) == dense
+
+
+# ---------------------------------------------------------------------------
+# trace_report section + bench schema (pure host)
+# ---------------------------------------------------------------------------
+
+def _fast_span(steps, calls, ts=1_000_000):
+    return {"ph": "X", "name": "sample/fast", "id": 1, "ts": ts, "dur": 50,
+            "pid": 0, "tid": 1, "tname": "t", "parent": None,
+            "args": {"steps": steps, "unet_calls": calls, "batch": 2}}
+
+
+def test_trace_report_fast_sampling_section():
+    from tools import trace_report as TR
+
+    records = [_fast_span(32, 16), _fast_span(32, 16, ts=2_000_000),
+               _fast_span(16, 12, ts=3_000_000)]
+    schema = TR.load_schema()
+    for rec in records:
+        assert TR.validate_record(rec, schema) == []
+    summary = TR.summarize(records)
+    fast = summary["fast_sampling"]
+    # spans are per batch execution; totals weight by args.batch (2 here)
+    assert fast["executions"] == 3
+    assert fast["trajectories"] == 6
+    assert fast["steps_total"] == 160
+    assert fast["unet_calls_total"] == 88
+    assert fast["calls_saved_total"] == 72
+    assert fast["calls_saved_histogram"] == {"4": 2, "16": 4}
+    text = TR.render_text(summary, [])
+    assert "fast sampling" in text
+    assert "4x trajectories saved 16 call(s)" in text
+    # dense traces keep their pre-fast report shape
+    dense = TR.summarize([{**_fast_span(8, 8), "name": "serve/device_step"}])
+    assert dense["fast_sampling"] is None
+
+
+def test_bench_fastsample_schema_validator():
+    from tools.bench_fastsample import validate_result
+
+    row = {"steps": 16, "ratio": 0.5, "order": 2, "unet_calls": 8,
+           "call_reduction": 2.0, "wall_s": 0.1, "ref_wall_s": 0.2,
+           "latency_speedup": 2.0, "sscd_sim_mean": 0.999,
+           "sscd_sim_min": 0.998, "fid": 0.001}
+    doc = {"model": "tiny", "sampler": "dpm++", "resolution": 16,
+           "prompts": 8, "image_size": 32, "sim_budget_mean": 0.995,
+           "sim_budget_min": 0.99, "min_call_reduction": 1.8,
+           "background_sim_mean": 0.97, "curve": [row],
+           "default_point": row, "pass": True}
+    assert validate_result(doc) == []
+    assert validate_result({**doc, "curve": []})
+    assert validate_result({**doc, "pass": "yes"})
+    bad_row = {**row, "sscd_sim_mean": "high"}
+    assert validate_result({**doc, "curve": [bad_row]})
+    # the banked artifact itself stays schema-valid
+    banked = json.loads(
+        (__import__("pathlib").Path(__file__).resolve().parent.parent
+         / "BENCH_FASTSAMPLE.json").read_text())
+    assert validate_result(banked) == []
+    assert banked["pass"] is True
+    assert banked["default_point"]["call_reduction"] >= 1.8
+
+
+# ---------------------------------------------------------------------------
+# sampler semantics (tiny-model compiles)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tiny_models():
+    cfg = TrainConfig()
+    cfg.model = ModelConfig.tiny()
+    return build_models(cfg, jax.random.key(0))
+
+
+def _sample_cfg(**kw):
+    d = dict(resolution=16, num_inference_steps=6, guidance_scale=7.5,
+             sampler="dpm++", im_batch=2, seed=0)
+    d.update(kw)
+    return SampleConfig(**d)
+
+
+def _inputs(models, n=4):
+    tok = HashTokenizer(models.text_encoder.config.text_vocab_size,
+                        models.text_encoder.config.text_max_length)
+    ids = np.repeat(tok(["a church", "a truck"]), n // 2, axis=0)
+    unc = np.broadcast_to(tok([""])[0], ids.shape).copy()
+    return ids, unc
+
+
+def test_bulk_fast_disabled_bit_identical(tiny_models, cpu_devices):
+    """The disabled path is the ORIGINAL program: fast.enabled=False and
+    fast enabled with an all-full plan (ratio 0) are byte-identical."""
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    ids, unc = _inputs(models)
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    base = np.asarray(
+        make_sampler(_sample_cfg(), models, mesh)(p, ids, unc,
+                                                  rngmod.root_key(1)))
+    ratio0 = np.asarray(
+        make_sampler(_sample_cfg(fast=FastSampleConfig(
+            enabled=True, reuse_ratio=0.0)), models, mesh)(
+                p, ids, unc, rngmod.root_key(1)))
+    np.testing.assert_array_equal(base, ratio0)
+
+
+def test_bulk_fast_reuse_differs_but_stays_close(tiny_models, cpu_devices):
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    ids, unc = _inputs(models)
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    base = np.asarray(
+        make_sampler(_sample_cfg(), models, mesh)(p, ids, unc,
+                                                  rngmod.root_key(1)))
+    fast = np.asarray(
+        make_sampler(_sample_cfg(fast=FastSampleConfig(
+            enabled=True, reuse_ratio=0.5)), models, mesh)(
+                p, ids, unc, rngmod.root_key(1)))
+    assert not np.array_equal(base, fast)
+    assert np.isfinite(fast).all()
+    assert fast.min() >= 0.0 and fast.max() <= 1.0
+    # score reuse approximates the dense trajectory, it does not replace
+    # the image with something unrelated
+    assert np.abs(base - fast).mean() < 0.15
+    # and it is deterministic
+    fast2 = np.asarray(
+        make_sampler(_sample_cfg(fast=FastSampleConfig(
+            enabled=True, reuse_ratio=0.5)), models, mesh)(
+                p, ids, unc, rngmod.root_key(1)))
+    np.testing.assert_array_equal(fast, fast2)
+
+
+def test_dpmpp_fast_scan_matches_dense_reference_loop(tiny_models,
+                                                      cpu_devices):
+    """The dpm++ second-order multistep state must advance through skipped
+    steps exactly as the spec says: a hand-unrolled python loop over the
+    SAME plan (full steps call the real UNet+CFG and bank; reuse steps
+    extrapolate from the bank; EVERY step runs dpmpp_2m_step) reproduces
+    the jitted scan's trajectory."""
+    models, params = tiny_models
+    mesh = pmesh.make_mesh(MeshConfig())
+    ids, unc = _inputs(models)
+    p = {"unet": params["unet"], "vae": params["vae"], "text": params["text"]}
+    cfg = _sample_cfg(fast=FastSampleConfig(enabled=True, reuse_ratio=0.5))
+    key = rngmod.root_key(3)
+    scan_images = np.asarray(make_sampler(cfg, models, mesh)(p, ids, unc,
+                                                             key))
+
+    sched = models.schedule
+    ts, prev_ts, lof, plan = fast_plan_grid("dpm++", sched, 6, 0.5)
+    assert not fastsample.is_dense(plan)
+    # mirror sample_fn's stochastic setup exactly
+    kp, kn, ks = (rngmod.stream_key(key, n)
+                  for n in ("emb_noise", "init", "steps"))
+    del kp, ks     # no mitigation noise; dpm++ draws no ancestral noise
+    cond, uncond = encode_prompts(models, p["text"], ids, unc)
+    ctx = jnp.concatenate([uncond, cond], axis=0)
+    from dcr_tpu.models.vae import vae_scale_factor
+
+    ls = 16 // vae_scale_factor(models.vae.config)
+    latent = jax.random.normal(
+        kn, (ids.shape[0], ls, ls,
+             models.vae.config.vae_latent_channels))
+    dpm = S.dpm_init_state(latent.shape)
+    banked = []            # [(pred, t)], newest last
+    x = latent
+    for i in range(6):
+        t, prev_t = int(ts[i]), int(prev_ts[i])
+        if plan[i]:
+            tb = jnp.full((2 * ids.shape[0],), t, jnp.int32)
+            pred = models.unet.apply({"params": p["unet"]},
+                                     jnp.concatenate([x, x], axis=0), tb,
+                                     ctx)
+            pred_u, pred_c = jnp.split(pred, 2, axis=0)
+            pred = pred_u + cfg.guidance_scale * (pred_c - pred_u)
+            banked.append((pred, float(t)))
+        else:
+            (p1, t1) = banked[-1]
+            if len(banked) >= 2:
+                (p0, t0) = banked[-2]
+                pred = p1 + (p1 - p0) * (t - t1) / (t1 - t0)
+            else:
+                pred = p1
+        x, dpm = S.dpmpp_2m_step(sched, pred, x, t, prev_t, dpm,
+                                 force_first_order=bool(lof) and i == 5)
+    images = models.vae.apply(
+        {"params": p["vae"]},
+        x / models.vae.config.vae_scaling_factor, method=models.vae.decode)
+    ref_images = np.asarray(jnp.clip(images * 0.5 + 0.5, 0.0, 1.0))
+    np.testing.assert_allclose(scan_images, ref_images, atol=2e-5, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# serve path (slow: compiled service stacks)
+# ---------------------------------------------------------------------------
+
+def _tiny_stack():
+    from dcr_tpu.sampling.pipeline import GenerationStack
+
+    tiny = ModelConfig.tiny()
+    tcfg = TrainConfig(mixed_precision="no")
+    tcfg.model = tiny
+    models, params = build_models(tcfg, jax.random.key(0))
+    tok = HashTokenizer(vocab_size=tiny.text_vocab_size,
+                        model_max_length=tiny.text_max_length)
+    return GenerationStack(models, params, tiny, tok,
+                           pmesh.make_mesh(MeshConfig()))
+
+
+def _service(stack, **cfg_kw):
+    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.serve.worker import GenerationService
+
+    kw = dict(resolution=16, num_inference_steps=6, sampler="dpm++",
+              max_batch=2, max_wait_ms=30.0, queue_depth=16, seed=0)
+    kw.update(cfg_kw)
+    return GenerationService(ServeConfig(**kw), stack)
+
+
+@pytest.mark.slow
+def test_serve_fast_bucket_purity_and_disabled_identity(tmp_path,
+                                                        cpu_devices):
+    """With fast on, the serve purity contract holds (alone vs mixed batch
+    bit-identical — the plan is batch-uniform and the reuse math is
+    elementwise); with ratio 0 the serve bucket is bit-identical to the
+    dense service. The fast batch also stamps a schema-valid sample/fast
+    span that trace_report turns into the calls-saved section."""
+    from dcr_tpu.core import tracing
+    from tools import trace_report as TR
+
+    trace_path = tracing.configure(tmp_path, rank=0)
+    stack = _tiny_stack()
+    dense = _service(stack)
+    fast = _service(stack, fast=FastSampleConfig(enabled=True,
+                                                 reuse_ratio=0.5))
+    ratio0 = _service(stack, fast=FastSampleConfig(enabled=True,
+                                                   reuse_ratio=0.0))
+    bd, bf, b0 = (s.default_bucket() for s in (dense, fast, ratio0))
+    assert bf.fast_ratio == 0.5 and b0.fast_ratio == 0.0
+
+    a = dense.execute([Request(prompt="a red square", seed=7, bucket=bd)])
+    b = ratio0.execute([Request(prompt="a red square", seed=7, bucket=b0)])
+    np.testing.assert_array_equal(a[0], b[0])
+
+    alone = fast.execute([Request(prompt="a red square", seed=7, bucket=bf)])
+    mixed = fast.execute([Request(prompt="a red square", seed=7, bucket=bf),
+                          Request(prompt="a blue circle", seed=9,
+                                  bucket=bf)])
+    np.testing.assert_array_equal(alone[0], mixed[0])
+    assert not np.array_equal(mixed[0], mixed[1])
+    assert not np.array_equal(alone[0], a[0])   # fast really differs
+
+    # trace plumbing: fast batches stamped, dense batches not
+    schema = TR.load_schema()
+    records = []
+    for line in trace_path.read_text().splitlines():
+        rec = json.loads(line)
+        assert TR.validate_record(rec, schema) == []
+        # summarize() runs on load_fleet() output, which stamps the stream
+        # label/index onto every record
+        rec["_plabel"], rec["_proc"] = "trace.jsonl", 0
+        records.append(rec)
+    fast_spans = [r for r in records
+                  if r["ph"] == "X" and r["name"] == "sample/fast"]
+    assert len(fast_spans) == 2      # the two fast.execute() batches
+    plan = fastsample.fast_plan(6, 0.5)
+    for sp in fast_spans:
+        assert sp["args"]["steps"] == 6
+        assert sp["args"]["unet_calls"] == fastsample.unet_calls(plan)
+    summary = TR.summarize(records)
+    # two executions (alone + mixed), three trajectories across them
+    assert summary["fast_sampling"]["executions"] == 2
+    assert summary["fast_sampling"]["trajectories"] == 3
+    assert summary["fast_sampling"]["call_reduction"] == round(
+        6 / fastsample.unet_calls(plan), 3)
+
+
+@pytest.mark.slow
+def test_serve_fast_ddpm_ancestral_purity(cpu_devices):
+    """The stochastic sampler keeps per-request ancestral-noise purity with
+    score reuse on (reuse substitutes the prediction; the per-row noise
+    draws are untouched)."""
+    stack = _tiny_stack()
+    svc = _service(stack, sampler="ddpm",
+                   fast=FastSampleConfig(enabled=True, reuse_ratio=0.5))
+    b = svc.default_bucket()
+    alone = svc.execute([Request(prompt="x", seed=3, bucket=b)])
+    mixed = svc.execute([Request(prompt="x", seed=3, bucket=b),
+                         Request(prompt="y", seed=4, bucket=b)])
+    np.testing.assert_array_equal(alone[0], mixed[0])

@@ -34,7 +34,15 @@ bench — scoring cost is per-IMAGE while generation cost scales with steps,
 so a 2-step tiny-model run would measure a regime no real deployment is in
 (SD-2.1 at 50 steps amortizes SSCD to well under 1%).
 
-Usage: python tools/bench_serve.py [--chaos|--risk]
+``--fast`` banks the dcr-fast serving win next to the chaos/risk legs: the
+same batched workload runs once on the dense default bucket and once with
+the fast plan on (``FastSampleConfig`` defaults: reuse_ratio 0.5, order 2),
+and BENCH_SERVE_FAST.json records throughput for both, the speedup, and
+the per-trajectory UNet-call reduction. The fidelity side of the same
+operating point is gated separately by tools/bench_fastsample.py — this
+leg is the wall-clock half of that story.
+
+Usage: python tools/bench_serve.py [--chaos|--risk|--fast]
 Env knobs (default mode): BENCH_SERVE_REQUESTS (default 32),
 BENCH_SERVE_BATCH (default 8), BENCH_SERVE_STEPS (default 4),
 BENCH_SERVE_RES (default 16, tiny model).
@@ -44,6 +52,10 @@ BENCH_SERVE_CHAOS_WORKERS (default 2), BENCH_SERVE_CHAOS_KILL_EVERY_S
 Env knobs (--risk): BENCH_RISK_REQUESTS (default 48), BENCH_RISK_STEPS
 (default 24), BENCH_RISK_IMAGE_SIZE (default 32), BENCH_RISK_INDEX_N
 (default 4096), BENCH_SERVE_BATCH / BENCH_SERVE_RES as above.
+Env knobs (--fast): BENCH_FAST_SERVE_REQUESTS (default 32),
+BENCH_FAST_SERVE_STEPS (default 32 — the UNet-dominated regime fast
+sampling targets), BENCH_FAST_REPS (median-of-N workload passes per leg,
+default 3), BENCH_SERVE_BATCH / BENCH_SERVE_RES as above.
 """
 
 from __future__ import annotations
@@ -60,6 +72,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 OUT = Path(__file__).resolve().parent.parent / "BENCH_SERVE.json"
 OUT_CHAOS = Path(__file__).resolve().parent.parent / "BENCH_SERVE_CHAOS.json"
 OUT_RISK = Path(__file__).resolve().parent.parent / "BENCH_RISK.json"
+OUT_FAST = Path(__file__).resolve().parent.parent / "BENCH_SERVE_FAST.json"
 
 
 def _build_stack():
@@ -81,14 +94,16 @@ def _build_stack():
                            pmesh.make_mesh(MeshConfig()))
 
 
-def _service(stack, *, max_batch: int, steps: int, res: int, risk=None):
-    from dcr_tpu.core.config import RiskConfig, ServeConfig
+def _service(stack, *, max_batch: int, steps: int, res: int, risk=None,
+             fast=None):
+    from dcr_tpu.core.config import FastSampleConfig, RiskConfig, ServeConfig
     from dcr_tpu.serve.worker import GenerationService
 
     cfg = ServeConfig(resolution=res, num_inference_steps=steps,
                       sampler="ddim", max_batch=max_batch, max_wait_ms=25.0,
                       queue_depth=256, seed=0,
-                      risk=risk if risk is not None else RiskConfig())
+                      risk=risk if risk is not None else RiskConfig(),
+                      fast=fast if fast is not None else FastSampleConfig())
     svc = GenerationService(cfg, stack)
     svc.start()
     return svc
@@ -723,10 +738,95 @@ def risk_main() -> None:
     print("RISK BENCH OK", flush=True)
 
 
+# ---------------------------------------------------------------------------
+# --fast: serving throughput with the dcr-fast score-reuse plan on
+# ---------------------------------------------------------------------------
+
+def fast_main() -> None:
+    from dcr_tpu.core.config import FastSampleConfig
+    from dcr_tpu.sampling import fastsample
+    from dcr_tpu.serve.queue import Request
+
+    n_requests = int(os.environ.get("BENCH_FAST_SERVE_REQUESTS", "32"))
+    max_batch = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
+    # more steps than the throughput bench: fast sampling's win scales with
+    # the denoiser fraction of a request, and a 4-step run measures batching
+    # overhead, not sampling
+    steps = int(os.environ.get("BENCH_FAST_SERVE_STEPS", "32"))
+    res = int(os.environ.get("BENCH_SERVE_RES", "16"))
+
+    cache_dir = Path(__file__).resolve().parent.parent / ".jax_cache"
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    print(f"bench_serve --fast: {n_requests} requests, max_batch={max_batch},"
+          f" steps={steps}, res={res}", flush=True)
+
+    stack = _build_stack()
+    prompts = _prompts(n_requests)
+    fast_cfg = FastSampleConfig(enabled=True)        # the default operating
+    plan = fastsample.fast_plan(steps, fast_cfg.reuse_ratio)  # point
+    calls = fastsample.unet_calls(plan)
+    result: dict = {"requests": n_requests, "max_batch": max_batch,
+                    "steps": steps, "resolution": res, "sampler": "ddim",
+                    "model": "tiny", "reuse_ratio": fast_cfg.reuse_ratio,
+                    "order": fast_cfg.order, "unet_calls_per_trajectory": calls,
+                    "call_reduction": round(steps / max(1, calls), 3)}
+
+    import statistics
+
+    reps = int(os.environ.get("BENCH_FAST_REPS", "3"))
+
+    def leg(fast=None) -> dict:
+        # median of `reps` workload passes per leg: cross-leg wall A/B on
+        # this shared box swings ±25% (see the --risk leg's rationale), so
+        # a single-shot comparison would gate on machine-load noise
+        svc = _service(stack, max_batch=max_batch, steps=steps, res=res,
+                       fast=fast)
+        svc.execute([Request(prompt="warmup", seed=0,
+                             bucket=svc.default_bucket())])
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=min(32, n_requests)) as ex:
+                futs = list(ex.map(
+                    lambda a: svc.submit(a[1], seed=a[0]).future,
+                    enumerate(prompts)))
+                for f in futs:
+                    f.result(timeout=600)
+            walls.append(time.perf_counter() - t0)
+        elapsed = statistics.median(walls)
+        snap = svc.metrics.snapshot()
+        svc.stop(timeout=60)
+        return {"total_s": round(elapsed, 3),
+                "reps": reps,
+                "requests_per_s": round(n_requests / elapsed, 3),
+                "latency_ms": snap["latency_ms"]}
+
+    result["dense"] = leg()
+    print("dense:", json.dumps(result["dense"]), flush=True)
+    result["fast"] = leg(fast=fast_cfg)
+    print("fast:", json.dumps(result["fast"]), flush=True)
+    result["speedup"] = round(result["dense"]["total_s"]
+                              / result["fast"]["total_s"], 3)
+    print(f"fast-plan speedup: {result['speedup']}x at "
+          f"{result['call_reduction']}x fewer UNet calls", flush=True)
+    OUT_FAST.write_text(json.dumps(result, indent=2) + "\n")
+    print(f"wrote {OUT_FAST}", flush=True)
+    if result["speedup"] <= 1.0:
+        # the plan skips real work; slower-than-dense means the machinery
+        # broke (or the box is so loaded the numbers are meaningless)
+        print("FAST BENCH FAIL: fast leg not faster than dense", flush=True)
+        raise SystemExit(1)
+    print("FAST BENCH OK", flush=True)
+
+
 if __name__ == "__main__":
     if "--chaos" in sys.argv[1:]:
         chaos_main()
     elif "--risk" in sys.argv[1:]:
         risk_main()
+    elif "--fast" in sys.argv[1:]:
+        fast_main()
     else:
         main()

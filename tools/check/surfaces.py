@@ -124,22 +124,28 @@ def _build_params_finite() -> dict:
     return dict(fn=_params_finite, args=(tree,), static_config={})
 
 
-def _build_serve_bucket(sampler: str) -> dict:
+def _build_serve_bucket(sampler: str, fast: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
-    from dcr_tpu.core.config import ServeConfig
+    from dcr_tpu.core.config import FastSampleConfig, ServeConfig
     from dcr_tpu.diffusion.trainer import build_modules
     from dcr_tpu.serve.queue import GenBucket
     from dcr_tpu.serve.worker import make_batch_sampler
 
-    scfg = ServeConfig(sampler=sampler)
+    scfg = ServeConfig(sampler=sampler, fast=FastSampleConfig(enabled=fast))
     cfg = _tiny_train_cfg()
     models = build_modules(cfg)
+    # fast=True is the dcr-fast score-reuse program at the FastSampleConfig
+    # DEFAULT operating point (the one BENCH_FASTSAMPLE gates): the plan is
+    # baked in, so the fast variant is a distinct surface entry whose
+    # fingerprint moves whenever the default ratio/order moves
     bucket = GenBucket(resolution=scfg.resolution,
                        steps=scfg.num_inference_steps,
                        guidance=scfg.guidance_scale, sampler=sampler,
-                       rand_noise_lam=scfg.rand_noise_lam)
+                       rand_noise_lam=scfg.rand_noise_lam,
+                       fast_ratio=(scfg.fast.reuse_ratio if fast else 0.0),
+                       fast_order=scfg.fast.order)
     fn = make_batch_sampler(bucket, models, scfg.seed, scfg.max_batch)
     params = _abstract_params(cfg)
     L = cfg.model.text_max_length
@@ -153,25 +159,29 @@ def _build_serve_bucket(sampler: str) -> dict:
             "guidance": bucket.guidance, "sampler": bucket.sampler,
             "rand_noise_lam": bucket.rand_noise_lam,
             "max_batch": scfg.max_batch,
+            "fast_ratio": bucket.fast_ratio,
+            "fast_order": bucket.fast_order,
         })
 
 
-def _build_bulk_sampler(sampler: str) -> dict:
+def _build_bulk_sampler(sampler: str, fast: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
     from dcr_tpu.core import rng as rngmod
-    from dcr_tpu.core.config import SampleConfig
+    from dcr_tpu.core.config import FastSampleConfig, SampleConfig
     from dcr_tpu.diffusion.trainer import build_modules
     from dcr_tpu.sampling.sampler import make_sampler
 
-    pcfg = SampleConfig(sampler=sampler)
+    pcfg = SampleConfig(sampler=sampler,
+                        fast=FastSampleConfig(enabled=fast))
     cfg = _tiny_train_cfg()
     models = build_modules(cfg)
     fn = make_sampler(pcfg, models, _mesh1())
     params = _abstract_params(cfg)
     ids = jax.ShapeDtypeStruct((pcfg.im_batch, cfg.model.text_max_length),
                                jnp.int32)
+    fast_ratio = pcfg.fast.reuse_ratio if pcfg.fast.enabled else 0.0
     return dict(
         fn=fn, args=(params, ids, ids, rngmod.root_key(0)),
         static_config={
@@ -180,6 +190,8 @@ def _build_bulk_sampler(sampler: str) -> dict:
             "guidance_scale": pcfg.guidance_scale, "sampler": sampler,
             "rand_noise_lam": pcfg.rand_noise_lam,
             "im_batch": pcfg.im_batch,
+            "fast_ratio": fast_ratio,
+            "fast_order": pcfg.fast.order,
         })
 
 
@@ -277,6 +289,15 @@ SURFACES: tuple[SurfaceSpec, ...] = (
                   (lambda s=s: _build_serve_bucket(s))) for s in SAMPLERS),
     *(SurfaceSpec(f"sample/sampler@{s}", "sample/sampler", s,
                   (lambda s=s: _build_bulk_sampler(s))) for s in SAMPLERS),
+    # dcr-fast score-reuse variants at the FastSampleConfig default
+    # operating point (ratio 0.5, order 2) on the default dpm++ sampler: a
+    # PR that changes the plan math, the reuse extrapolation, or the
+    # default operating point changes these fingerprints
+    SurfaceSpec("serve/batch_sampler@dpm++-fast", "serve/batch_sampler",
+                "dpm++-fast", lambda: _build_serve_bucket(
+                    "dpm++", fast=True)),
+    SurfaceSpec("sample/sampler@dpm++-fast", "sample/sampler", "dpm++-fast",
+                lambda: _build_bulk_sampler("dpm++", fast=True)),
     SurfaceSpec("serve/encode@default", "serve/encode", "default",
                 _build_serve_encode),
     SurfaceSpec("eval/embed@default", "eval/embed", "default",

@@ -383,6 +383,46 @@ def copy_risk_summary(records: list[dict]) -> dict | None:
     }
 
 
+def fast_sampling_summary(records: list[dict]) -> dict | None:
+    """The "Fast sampling" section (dcr-fast): denoiser-call reduction from
+    ``sample/fast`` spans — one per accelerated batch EXECUTION, carrying
+    the static ``steps`` (solver steps taken) and ``unet_calls`` (denoiser
+    calls actually made) of its plan plus ``batch`` (trajectories sharing
+    it: the plan is batch-uniform, so per-trajectory totals are the span
+    numbers weighted by batch). None when nothing ran fast — dense traces
+    keep their pre-fast report shape."""
+    spans = [r for r in records
+             if r["ph"] == "X" and r["name"] == "sample/fast"]
+    rows = []
+    for s in spans:
+        steps = s["args"].get("steps")
+        calls = s["args"].get("unet_calls")
+        batch = s["args"].get("batch")
+        if isinstance(steps, int) and isinstance(calls, int) and steps > 0:
+            rows.append((steps, calls,
+                         batch if isinstance(batch, int) and batch > 0
+                         else 1))
+    if not rows:
+        return None
+    total_steps = sum(s * b for s, _, b in rows)
+    total_calls = sum(c * b for _, c, b in rows)
+    # calls-saved histogram: how many trajectories skipped how many calls
+    saved_hist: dict[str, int] = {}
+    for steps, calls, batch in rows:
+        key = str(steps - calls)
+        saved_hist[key] = saved_hist.get(key, 0) + batch
+    return {
+        "executions": len(rows),
+        "trajectories": sum(b for _, _, b in rows),
+        "steps_total": total_steps,
+        "unet_calls_total": total_calls,
+        "calls_saved_total": total_steps - total_calls,
+        "call_reduction": round(total_steps / max(1, total_calls), 3),
+        "calls_saved_histogram": dict(sorted(saved_hist.items(),
+                                             key=lambda kv: int(kv[0]))),
+    }
+
+
 def compiles_per_incarnation(records: list[dict]) -> dict[str, int]:
     """XLA compiles per PROCESS INCARNATION — the recompile-budget unit.
 
@@ -474,6 +514,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "serve_recompiles_per_bucket": recompiles,
         "compiles_per_incarnation": compiles_per_incarnation(records),
         "copy_risk": copy_risk_summary(records),
+        "fast_sampling": fast_sampling_summary(records),
         "fault_timeline": faults,
         "fleet": fleet_summary(records, meta or {}),
     }
@@ -563,6 +604,17 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         lines.append("XLA compiles per process incarnation:")
         for inc, n in summary["compiles_per_incarnation"].items():
             lines.append(f"  {n}x {inc}")
+    fast = summary.get("fast_sampling")
+    if fast:
+        lines.append(
+            f"\nfast sampling: {fast['trajectories']} trajectory(ies) in "
+            f"{fast['executions']} execution(s) — "
+            f"{fast['unet_calls_total']} UNet calls for "
+            f"{fast['steps_total']} solver steps "
+            f"({fast['call_reduction']}x fewer calls, "
+            f"{fast['calls_saved_total']} saved)")
+        for saved, count in fast["calls_saved_histogram"].items():
+            lines.append(f"  {count}x trajectories saved {saved} call(s)")
     risk = summary.get("copy_risk")
     if risk:
         lines.append(f"\ncopy risk: {risk['scored']} generation(s) scored, "
