@@ -253,6 +253,43 @@ class WarmCacheConfig:
 
 
 @dataclass
+class PipeConfig:
+    """Pipelined training (dcr_tpu/diffusion/encode_stage.py): split the
+    fused train step into a pure denoiser+optimizer hot step and a frozen-
+    encoder producer stage that runs VAE-encode (+ text-encode when the text
+    encoder is frozen) one-or-more steps ahead of the trainer, feeding a
+    bounded device-side prefetch ring. With ``enabled=False`` (the default)
+    the trainer builds the ORIGINAL fused step — disabled mode is
+    bit-identical by construction (the fused program's HLO digest in
+    compile_manifest.json does not move). RNG stream ownership is explicit:
+    the producer owns the ``vae_sample`` stream, the denoiser owns
+    ``noise``/``timesteps``/``emb_noise``/``mixup_*`` — so the q-sample
+    draws are unchanged between fused and pipelined runs.
+
+    ``latent_cache`` points at a persistent latent cache directory
+    (data/latent_cache.py, built by ``dcr-precompute-latents``): the
+    producer then reads precomputed VAE posterior moments + text embeddings
+    instead of running the encoders at all — one precompute amortizes
+    encoder work across every duplication/mitigation regime trained against
+    the same images (the paper's experiment matrix). Setting it implies
+    pipelined mode."""
+
+    enabled: bool = False
+    # prefetch ring depth: encoded batches the producer may run ahead of the
+    # denoiser (device memory for `depth` latent/ctx batches)
+    depth: int = 2
+    # persistent latent cache dir ("" = live encoders). Keyed on params
+    # fingerprint + dataset + resolution; verified before load, corrupt
+    # shards are quarantined and their samples re-encoded live.
+    latent_cache: str = ""
+    # samples per cache shard at precompute time: the blast radius of one
+    # corrupt/torn shard (its indices degrade to live recompute; losing
+    # EVERY shard is a typed error, so small datasets benefit from small
+    # shards)
+    cache_shard_size: int = 512
+
+
+@dataclass
 class FastSampleConfig:
     """Training-free sampler acceleration (dcr_tpu/sampling/fastsample.py):
     a host-computed per-step plan of ``full | reuse`` entries à la PFDiff —
@@ -360,6 +397,7 @@ class TrainConfig:
     fault: FaultToleranceConfig = field(default_factory=FaultToleranceConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
     risk: RiskConfig = field(default_factory=RiskConfig)
+    pipe: PipeConfig = field(default_factory=PipeConfig)
 
 
 @dataclass
@@ -541,6 +579,49 @@ def validate_risk_config(r: RiskConfig) -> None:
         raise ValueError("risk.threshold must be a number, not NaN")
     if r.max_evidence < 0:
         raise ValueError("risk.max_evidence must be >= 0")
+
+
+def validate_pipe_config(cfg: "TrainConfig") -> None:
+    p = cfg.pipe
+    if p.depth < 1:
+        raise ValueError("pipe.depth must be >= 1 (the prefetch ring needs "
+                         "at least one slot)")
+    if p.cache_shard_size < 1:
+        raise ValueError("pipe.cache_shard_size must be >= 1")
+    if p.latent_cache:
+        # cache-fed training freezes ONE realization per image — of the
+        # caption/ctx AND of the pixel transform. Regimes that must redraw
+        # either per occurrence cannot be served from it (the posterior
+        # MOMENTS themselves are regime-independent; the per-occurrence
+        # posterior sample still draws live).
+        if cfg.train_text_encoder:
+            raise ValueError(
+                "pipe.latent_cache requires train_text_encoder=False: the "
+                "cache replaces the frozen text encoder's output; a trained "
+                "text encoder must run live (use pipe.enabled without a "
+                "cache)")
+        if cfg.data.trainspecial != "none":
+            raise ValueError(
+                "pipe.latent_cache is incompatible with caption mitigations "
+                "(data.trainspecial): they redraw captions per occurrence, "
+                "but the cache holds one frozen text embedding per image")
+        if cfg.data.duplication == "dup_image":
+            raise ValueError(
+                "pipe.latent_cache is incompatible with duplication="
+                "'dup_image': that regime redraws a DIFFERENT caption per "
+                "occurrence of a duplicated image, but the cache holds one "
+                "frozen text embedding per image (dup_both/nodup are fine "
+                "— their captions are deterministic per index)")
+        if cfg.data.random_flip:
+            raise ValueError(
+                "pipe.latent_cache requires data.random_flip=false: the "
+                "cache holds one pixel realization per image, a "
+                "per-occurrence flip cannot be served from it")
+        if not cfg.data.center_crop:
+            raise ValueError(
+                "pipe.latent_cache requires data.center_crop=true: "
+                "center_crop=false draws a RANDOM crop per occurrence, "
+                "which the cache would silently freeze to one realization")
 
 
 @dataclass
@@ -751,6 +832,7 @@ def validate_train_config(cfg: TrainConfig) -> None:
         # caption mitigations are blip-captions-only (reference diff_train.py:741-743)
         raise ValueError("trainspecial mitigations require class_prompt=instancelevel_blip")
     validate_risk_config(cfg.risk)
+    validate_pipe_config(cfg)
     if cfg.model.seq_parallel_mode not in ("ring", "ulysses"):
         raise ValueError("seq_parallel_mode must be 'ring' or 'ulysses'")
     ft = cfg.fault

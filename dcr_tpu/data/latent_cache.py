@@ -1,0 +1,344 @@
+"""dcr-pipe: persistent latent cache — compute the frozen-encoder work once.
+
+The paper's experiment matrix finetunes the SAME images under many
+duplication/caption/mitigation regimes; every one of those runs re-pays the
+frozen VAE encode and frozen text encode per step. ``dcr-precompute-latents``
+(cli/precompute.py) runs the encode stage (diffusion/encode_stage.py,
+``emit="moments"``) over a dataset ONCE and this module persists the result:
+
+- per ACTIVE dataset index: the VAE posterior **moments** (mean, std — not
+  a sample: the per-occurrence posterior draw stays a train-time decision
+  keyed on the ``vae_sample`` RNG stream, so one cache serves every epoch
+  and every duplication regime without freezing the latent noise) and the
+  frozen text embedding (``ctx``) of that index's caption realization;
+- a manifest keyed on a **fingerprint** of everything the latents depend
+  on: VAE/text-encoder param digests, the dataset's path list, resolution /
+  crop / caption regime, and the tokenizer — a cache built from different
+  weights or a different dataset is *detected by key*, never trained on
+  blind.
+
+Verification discipline (the warmcache/copyrisk-dump pattern): every shard
+is sha256-verified from bytes BEFORE ``np.load`` touches it and
+sanity-checked (shapes, finiteness) after; a damaged shard is quarantined
+out of the key space (``warmcache.quarantine_rename``), counted as a
+``latentcache/*`` fault, and its indices simply become cache misses — the
+producer's recompute path (encode_stage.cached_encode) re-encodes those
+batches live. The ``latent_cache_corrupt@load=N`` fault kind
+(utils/faults.py) damages the Nth shard read in memory so CI drives that
+verify → quarantine → recompute path deterministically.
+
+Layout::
+
+    <dir>/manifest.json                 # fingerprint + shard shas
+    <dir>/shard_00000.npz               # index/mean/std/ctx arrays
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from io import BytesIO
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+from dcr_tpu.core.warmcache import quarantine_rename
+
+CACHE_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+DEFAULT_SHARD_SIZE = 512
+
+
+class LatentCacheError(RuntimeError):
+    """Typed: the cache directory cannot serve this run (absent manifest,
+    fingerprint mismatch, or no readable shards). The caller decides whether
+    that is fatal (training explicitly asked for a cache) or a degrade."""
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def params_digest(tree) -> str:
+    """Content digest of a param pytree (path-ordered leaf bytes). The cache
+    key half that says 'encoded with THESE frozen weights'."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in sorted(leaves, key=lambda kv: jax.tree_util.keystr(kv[0])):
+        arr = np.asarray(jax.device_get(leaf))
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.dtype).encode() + str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def cache_fingerprint(cfg, dataset, tokenizer, *, vae_params,
+                      text_params) -> dict:
+    """Everything a cached latent/ctx depends on. Equal fingerprint <=> the
+    cache holds exactly what this run's encoders would compute."""
+    paths_sha = _sha("\n".join(
+        dataset.paths[int(i)] for i in dataset.active_indices).encode())
+    d = cfg.data
+    m = cfg.model
+    fp = {
+        "version": CACHE_VERSION,
+        "vae_sha": params_digest(vae_params),
+        "text_sha": params_digest(text_params),
+        "tokenizer": tokenizer.fingerprint(),
+        "dataset_sha": paths_sha,
+        "samples": int(len(dataset)),
+        "data": {
+            "resolution": d.resolution, "center_crop": d.center_crop,
+            "random_flip": d.random_flip, "class_prompt": d.class_prompt,
+            "instance_prompt": d.instance_prompt,
+            "caption_jsons": list(d.caption_jsons),
+            "rand_caption_tokens": d.rand_caption_tokens,
+            "trainsubset": d.trainsubset, "seed": d.seed,
+        },
+        "model": {
+            "sample_size": m.sample_size,
+            "vae_block_out_channels": list(m.vae_block_out_channels),
+            "vae_latent_channels": m.vae_latent_channels,
+            "vae_scaling_factor": m.vae_scaling_factor,
+            "text_hidden_size": m.text_hidden_size,
+            "text_max_length": m.text_max_length,
+            "mixed_precision": cfg.mixed_precision,
+        },
+    }
+    # one JSON round-trip so the in-memory fingerprint is byte-equal to what
+    # the manifest deserializes to (tuple->list etc.) — same discipline as
+    # warmcache.program_fingerprint
+    return json.loads(json.dumps(fp, sort_keys=True, default=str))
+
+
+class LatentCacheWriter:
+    """Accumulate encoded rows and persist shards + manifest atomically.
+
+    Write order is shards first, manifest last (write-to-temp + rename), so
+    a killed precompute leaves either a complete cache or no manifest —
+    never a manifest naming shards that don't verify."""
+
+    def __init__(self, cache_dir: str | Path, fingerprint: dict, *,
+                 shard_size: Optional[int] = None):
+        self.dir = Path(cache_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fingerprint = fingerprint
+        # None -> the module default, resolved at call time so tests can
+        # shrink shards through DEFAULT_SHARD_SIZE
+        self.shard_size = max(1, shard_size or DEFAULT_SHARD_SIZE)
+        self._rows: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
+        self._pending = 0
+        self._shards: list[dict] = []
+        self._total = 0
+
+    def add(self, index: np.ndarray, mean: np.ndarray, std: np.ndarray,
+            ctx: np.ndarray) -> None:
+        index = np.asarray(index, np.int64)
+        self._rows.append((index, np.asarray(mean, np.float32),
+                           np.asarray(std, np.float32),
+                           np.asarray(ctx, np.float32)))
+        self._pending += len(index)
+        while self._pending >= self.shard_size:
+            self._flush_shard(self.shard_size)
+
+    def _flush_shard(self, take: int) -> None:
+        idx = np.concatenate([r[0] for r in self._rows])
+        mean = np.concatenate([r[1] for r in self._rows])
+        std = np.concatenate([r[2] for r in self._rows])
+        ctx = np.concatenate([r[3] for r in self._rows])
+        take = min(take, len(idx))
+        buf = BytesIO()
+        np.savez(buf, index=idx[:take], mean=mean[:take], std=std[:take],
+                 ctx=ctx[:take])
+        blob = buf.getvalue()
+        name = f"shard_{len(self._shards):05d}.npz"
+        path = self.dir / name
+        tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
+        tmp.write_bytes(blob)
+        os.replace(tmp, path)
+        self._shards.append({"file": name, "sha256": _sha(blob),
+                             "count": int(take)})
+        self._total += take
+        rest = (idx[take:], mean[take:], std[take:], ctx[take:])
+        self._rows = [rest] if len(rest[0]) else []
+        self._pending = len(rest[0])
+
+    def finalize(self) -> Path:
+        """Flush the tail shard and commit the manifest."""
+        while self._pending:
+            self._flush_shard(self.shard_size)
+        doc = {"version": CACHE_VERSION, "created_at": time.time(),
+               "fingerprint": self.fingerprint, "total": self._total,
+               "shards": self._shards}
+        path = self.dir / MANIFEST_NAME
+        tmp = path.with_name(f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        tracing.event("latentcache/finalized", shards=len(self._shards),
+                      rows=self._total)
+        return path
+
+
+class LatentCacheReader:
+    """Verify-before-load reader with per-shard quarantine.
+
+    Construction loads and verifies the whole cache: an unreadable/mismatched
+    manifest raises :class:`LatentCacheError` (training explicitly asked for
+    a cache that cannot serve it — silent slow fallback would mask the
+    loss); a corrupt SHARD, by contrast, is quarantined and its indices
+    degrade to recompute misses, because losing one shard of a valid cache
+    must not forfeit the other 95% of the win.
+    """
+
+    def __init__(self, cache_dir: str | Path,
+                 expected_fingerprint: Optional[dict] = None):
+        self.dir = Path(cache_dir)
+        self._load_seq = 0
+        manifest = self._read_manifest()
+        if expected_fingerprint is not None and \
+                manifest["fingerprint"] != expected_fingerprint:
+            diffs = _fingerprint_diff(manifest["fingerprint"],
+                                      expected_fingerprint)
+            R.bump_counter("latentcache/fingerprint_mismatch")
+            raise LatentCacheError(
+                f"latent cache {self.dir} was built for a different "
+                f"run: fingerprint differs at {diffs} — re-run "
+                "dcr-precompute-latents for this config/weights")
+        self.fingerprint = manifest["fingerprint"]
+        self.total = int(manifest.get("total", 0))
+        # per-shard arrays, never concatenated: lookup() gathers rows
+        # through an index -> (shard, row) map, so peak host memory is the
+        # verified shards themselves — no monolithic second copy
+        self._row_of: dict[int, tuple[int, int]] = {}
+        self._shards: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        for shard in manifest["shards"]:
+            arrays = self._load_shard(shard)
+            if arrays is None:
+                continue
+            idx, mean, std, ctx = arrays
+            si = len(self._shards)
+            for j, i in enumerate(idx):
+                self._row_of[int(i)] = (si, j)
+            self._shards.append((mean, std, ctx))
+        if not self._shards:
+            raise LatentCacheError(
+                f"latent cache {self.dir}: no shard survived verification "
+                f"({len(manifest['shards'])} listed)")
+        self.cached = len(self._row_of)
+        tracing.event("latentcache/loaded", rows=self.cached,
+                      total=self.total, shards=len(self._shards))
+
+    # -- verification --------------------------------------------------------
+
+    def _read_manifest(self) -> dict:
+        path = self.dir / MANIFEST_NAME
+        try:
+            raw = R.read_bytes_with_retry(path, name="latent_cache_manifest")
+        except FileNotFoundError:
+            raise LatentCacheError(
+                f"latent cache {self.dir} has no {MANIFEST_NAME} — run "
+                "dcr-precompute-latents first") from None
+        except OSError as e:
+            raise LatentCacheError(
+                f"latent cache manifest unreadable: {e!r}") from e
+        try:
+            doc = json.loads(raw.decode("utf-8"))
+            if not isinstance(doc.get("shards"), list) or \
+                    "fingerprint" not in doc:
+                raise ValueError("manifest missing shards/fingerprint")
+            return doc
+        except (UnicodeDecodeError, ValueError) as e:
+            dest = quarantine_rename(path)
+            R.log_event("latent_cache_manifest_corrupt", error=repr(e),
+                        path=str(path),
+                        quarantined_to=str(dest) if dest else None)
+            R.bump_counter("latentcache/manifest_corrupt")
+            raise LatentCacheError(
+                f"latent cache manifest corrupt ({e}); quarantined — re-run "
+                "dcr-precompute-latents") from e
+
+    def _load_shard(self, shard: dict):
+        from dcr_tpu.utils import faults
+
+        path = self.dir / str(shard.get("file", ""))
+        try:
+            blob = R.read_bytes_with_retry(path, name="latent_cache_shard")
+        except (FileNotFoundError, OSError) as e:
+            self._quarantine(path, "shard_missing", repr(e), rename=False)
+            return None
+        seq = self._load_seq
+        self._load_seq += 1
+        if faults.fire("latent_cache_corrupt", load=seq):
+            # deterministic CI poisoning: damage the blob in memory so the
+            # REAL verify/quarantine/recompute path runs end to end
+            mid = len(blob) // 2
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] \
+                if blob else b""
+        if _sha(blob) != shard.get("sha256"):
+            self._quarantine(path, "shard_corrupt", "sha256 mismatch")
+            return None
+        try:
+            with np.load(BytesIO(blob)) as z:
+                idx = np.asarray(z["index"], np.int64)
+                mean, std, ctx = (np.asarray(z[k], np.float32)
+                                  for k in ("mean", "std", "ctx"))
+        except Exception as e:
+            self._quarantine(path, "shard_corrupt", f"unreadable npz: {e!r}")
+            return None
+        n = len(idx)
+        if not (len(mean) == len(std) == len(ctx) == n == shard.get("count")):
+            self._quarantine(path, "shard_corrupt", "row-count mismatch")
+            return None
+        if not (np.isfinite(mean).all() and np.isfinite(std).all()
+                and np.isfinite(ctx).all()):
+            self._quarantine(path, "shard_corrupt", "non-finite values")
+            return None
+        return idx, mean, std, ctx
+
+    def _quarantine(self, path: Path, kind: str, detail: str,
+                    rename: bool = True) -> None:
+        dest = quarantine_rename(path) if rename else None
+        R.log_event("latent_cache_quarantined", kind=kind, detail=detail,
+                    shard=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        R.bump_counter(f"latentcache/{kind}")
+
+    # -- serving -------------------------------------------------------------
+
+    def lookup(self, indices: np.ndarray):
+        """(mean, std, ctx) batch rows for ``indices``, or None when any
+        index is uncached (the caller re-encodes that batch live)."""
+        rows = []
+        for i in np.asarray(indices):
+            row = self._row_of.get(int(i))
+            if row is None:
+                return None
+            rows.append(row)
+        gathered = [self._shards[si] for si, _ in rows]
+        return tuple(
+            np.stack([shard[f][rj] for shard, (_, rj) in zip(gathered, rows)])
+            for f in range(3))
+
+    def coverage(self) -> tuple[int, int]:
+        """(indices served from cache, indices the manifest promised)."""
+        return self.cached, self.total
+
+
+def _fingerprint_diff(a: dict, b: dict, prefix: str = "") -> list[str]:
+    """Dotted paths where two fingerprints differ (readable errors)."""
+    diffs: list[str] = []
+    for key in sorted(set(a) | set(b)):
+        va, vb = a.get(key), b.get(key)
+        path = f"{prefix}{key}"
+        if isinstance(va, dict) and isinstance(vb, dict):
+            diffs.extend(_fingerprint_diff(va, vb, prefix=f"{path}."))
+        elif va != vb:
+            diffs.append(path)
+    return diffs[:10]

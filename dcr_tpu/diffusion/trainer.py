@@ -207,7 +207,44 @@ class Trainer:
             cfg, self.models, unet_params=params["unet"],
             text_params=params["text"], vae_params=params["vae"])
         self.state = T.shard_train_state(self.state, self.mesh)
-        self.step_fn = T.make_train_step(cfg, self.models, self.mesh)
+        # dcr-pipe: pipelined mode splits the fused step into a frozen-
+        # encoder producer stage + a denoiser-only hot step
+        # (diffusion/encode_stage.py). Single-host only: the producer thread
+        # dispatching device programs concurrently with the consumer is a
+        # collective-ordering hazard on a pod, and the fused path there is
+        # already correct.
+        self.pipelined = bool(cfg.pipe.enabled or cfg.pipe.latent_cache)
+        if self.pipelined and jax.process_count() > 1:
+            if cfg.pipe.latent_cache:
+                # an explicitly configured cache must never be discarded
+                # silently — the whole point of the cache contract is that
+                # "slower than asked for" is an error, not a degrade
+                raise ValueError(
+                    "pipe.latent_cache is single-host for now (the producer "
+                    "thread's device dispatch is a collective-ordering "
+                    "hazard on a pod) — drop the flag on multi-host runs "
+                    "or run the regime matrix on single-host workers")
+            log.warning("pipelined training disabled: %d processes (the "
+                        "producer thread is single-host only; training "
+                        "continues on the fused step)", jax.process_count())
+            R.log_event("pipelined_disabled_multihost",
+                        processes=jax.process_count())
+            self.pipelined = False
+        if self.pipelined:
+            from dcr_tpu.diffusion import encode_stage as E
+
+            self._E = E
+            self.encode_fn = E.make_encode_stage(cfg, self.models, self.mesh)
+            self.denoise_fn = E.make_denoise_step(cfg, self.models, self.mesh)
+            # the fused program is deliberately NOT built in pipelined mode
+            # (one less resident executable); pipelined-off builds ONLY the
+            # fused program, whose HLO is unchanged by this feature
+            self.step_fn = None
+            self._denoise_call = self.denoise_fn
+            self._cache_reader = None
+            self._cache_fn = None
+        else:
+            self.step_fn = T.make_train_step(cfg, self.models, self.mesh)
         # what the loop actually calls: the jit function by default, replaced
         # by a warm-cache AOT executable (with a one-way jit fallback) when
         # cfg.warm.dir is set (_warm_start, after restore) — so a preempted
@@ -410,6 +447,15 @@ class Trainer:
         return flops_of_jitted(self.step_fn, self.state, sharded_batch,
                                self.train_key)
 
+    def _denoise_flops(self, enc) -> float:
+        """Pipelined-mode MFU numerator: FLOPs of the denoiser-only hot step
+        — the point of the split is exactly that this excludes the frozen
+        encoders, so the reported MFU is the hot loop's."""
+        from dcr_tpu.utils.profiling import flops_of_jitted
+
+        return flops_of_jitted(self.denoise_fn, self._hot, enc,
+                               self.train_key)
+
     # -- preemption ----------------------------------------------------------
 
     def install_preemption_handler(self, signals=None) -> None:
@@ -459,6 +505,22 @@ class Trainer:
         if self.replica_mode and not dist.is_primary():
             return 0
         return self.loader.epoch_bad_count
+
+    def _make_producer(self, epoch_iter, start_step: int):
+        """dcr-pipe: the per-epoch producer — live frozen-encoder stage, or
+        the latent-cache stage (with the live stage as the recompute
+        fallback for quarantined/uncached indices) when a cache is loaded."""
+        E = self._E
+        live = E.live_encode(self.encode_fn, self._frozen, self.mesh,
+                             self.train_key)
+        if self._cache_reader is not None:
+            encode = E.cached_encode(self._cache_fn, self._cache_reader,
+                                     self.mesh, self.train_key, live)
+        else:
+            encode = live
+        return E.EncodeProducer(epoch_iter, encode,
+                                depth=self.cfg.pipe.depth,
+                                start_step=start_step)
 
     def _warm_start(self) -> None:
         """Resolve the train step and the params-finite check through the
@@ -514,20 +576,110 @@ class Trainer:
             "train_batch_size": cfg.train_batch_size,
         }
         with R.stage("train_warm"):
-            res = warmcache.aot_compile(
-                "train/step", self.step_fn,
-                (self.state, batch_avals, self.train_key),
-                static_config=static, cache=cache)
-            self._step_call = warmcache.guarded(res.fn, self.step_fn,
-                                                "train/step")
+            if self.pipelined:
+                self._warm_start_pipelined(cache, batch_avals, static)
+                res = None
+            else:
+                res = warmcache.aot_compile(
+                    "train/step", self.step_fn,
+                    (self.state, batch_avals, self.train_key),
+                    static_config=static, cache=cache)
+                self._step_call = warmcache.guarded(res.fn, self.step_fn,
+                                                    "train/step")
             tree = T.trainable_of(self.state, cfg.train_text_encoder)
             pf = warmcache.aot_compile("train/params_finite", _params_finite,
                                        (tree,), static_config={}, cache=cache)
             self._pf_fn = warmcache.guarded(pf.fn, _params_finite,
                                             "train/params_finite")
-        log.info("warm start: train/step %s in %.2fs, params_finite %s "
-                 "(cache %s)", res.source, res.build_s, pf.source,
-                 cfg.warm.dir)
+        if res is not None:
+            log.info("warm start: train/step %s in %.2fs, params_finite %s "
+                     "(cache %s)", res.source, res.build_s, pf.source,
+                     cfg.warm.dir)
+
+    def _enc_avals(self, local_bs: int):
+        """The encoded-batch pytree avals the denoiser hot step consumes —
+        the encode stage's output contract, mirrored for AOT lowering."""
+        from dcr_tpu.core.precision import policy_from_string
+        from dcr_tpu.models.vae import vae_scale_factor
+
+        cfg = self.cfg
+        bs = pmesh.batch_sharding(self.mesh)
+        lat = cfg.data.resolution // vae_scale_factor(cfg.model)
+        policy = policy_from_string(cfg.mixed_precision)
+        enc = {
+            "latents": jax.ShapeDtypeStruct(
+                (local_bs, lat, lat, cfg.model.vae_latent_channels),
+                jnp.float32, sharding=bs),
+            "index": jax.ShapeDtypeStruct(
+                (local_bs,), jax.dtypes.canonicalize_dtype(jnp.int64),
+                sharding=bs),
+        }
+        if cfg.train_text_encoder:
+            enc["input_ids"] = jax.ShapeDtypeStruct(
+                (local_bs, cfg.model.text_max_length), jnp.int32, sharding=bs)
+        else:
+            enc["ctx"] = jax.ShapeDtypeStruct(
+                (local_bs, cfg.model.text_max_length,
+                 cfg.model.text_hidden_size), policy.compute_dtype,
+                sharding=bs)
+        return enc
+
+    def _warm_start_pipelined(self, cache, batch_avals: dict,
+                              static: dict) -> None:
+        """dcr-pipe warm start: pre-populate the denoiser hot step and the
+        producer stage (live encode, or the latent-cache stage when a cache
+        is configured) from the persistent executable cache."""
+        from dcr_tpu.core import warmcache
+
+        cfg = self.cfg
+        E = self._E
+        local_bs = cfg.train_batch_size * jax.local_device_count()
+        enc_avals = self._enc_avals(local_bs)
+        hot, frozen = E.split_state(self.state, cfg.train_text_encoder)
+        # NOTE: pipe.depth is host-side ring capacity, not baked into any
+        # program — keeping it out of the key means retuning the ring never
+        # invalidates the warm cache (and matches surfaces.py's statics)
+        step_aval = jax.ShapeDtypeStruct((), jnp.uint32)
+        res = warmcache.aot_compile(
+            "train/denoise", self.denoise_fn,
+            (hot, enc_avals, self.train_key),
+            static_config=static, cache=cache)
+        self._denoise_call = warmcache.guarded(res.fn, self.denoise_fn,
+                                               "train/denoise")
+        if self._cache_fn is not None:
+            moments = dict(self._moments_avals(local_bs),
+                           index=enc_avals["index"])
+            stage = warmcache.aot_compile(
+                "train/encode_cached", self._cache_fn,
+                (moments, self.train_key, step_aval),
+                static_config=static, cache=cache)
+            self._cache_fn = warmcache.guarded(stage.fn, self._cache_fn,
+                                               "train/encode_cached")
+        else:
+            stage = warmcache.aot_compile(
+                "train/encode", self.encode_fn,
+                (frozen, batch_avals, self.train_key, step_aval),
+                static_config=static, cache=cache)
+            self.encode_fn = warmcache.guarded(stage.fn, self.encode_fn,
+                                               "train/encode")
+        log.info("warm start (pipelined): train/denoise %s in %.2fs, "
+                 "producer stage %s in %.2fs (cache %s)", res.source,
+                 res.build_s, stage.source, stage.build_s, cfg.warm.dir)
+
+    def _moments_avals(self, local_bs: int) -> dict:
+        """Latent-cache moments avals (mean/std/ctx) for AOT lowering."""
+        from dcr_tpu.models.vae import vae_scale_factor
+
+        cfg = self.cfg
+        bs = pmesh.batch_sharding(self.mesh)
+        lat = cfg.data.resolution // vae_scale_factor(cfg.model)
+        moment = jax.ShapeDtypeStruct(
+            (local_bs, lat, lat, cfg.model.vae_latent_channels), jnp.float32,
+            sharding=bs)
+        ctx = jax.ShapeDtypeStruct(
+            (local_bs, cfg.model.text_max_length,
+             cfg.model.text_hidden_size), jnp.float32, sharding=bs)
+        return {"mean": moment, "std": moment, "ctx": ctx}
 
     def train(self) -> dict:
         try:
@@ -547,11 +699,34 @@ class Trainer:
             # a checkpoint a peer can't see) would desynchronize every
             # collective that follows — fail fast with the per-rank values
             self.coord.assert_same("resume_step", start_step)
+        # dcr-pipe: resolve the latent cache BEFORE warm start (the cache
+        # stage is one of the programs to warm) and AFTER restore (the
+        # fingerprint hashes the restored frozen params). A cache that
+        # cannot serve this run raises LatentCacheError — training against
+        # the wrong latents silently is never an option.
+        if self.pipelined and cfg.pipe.latent_cache:
+            from dcr_tpu.data import latent_cache as LC
+
+            expected = LC.cache_fingerprint(
+                cfg, self.dataset, self.tokenizer,
+                vae_params=self.state.vae_params,
+                text_params=self.state.text_params)
+            with R.stage("latent_cache_load"):
+                self._cache_reader = LC.LatentCacheReader(
+                    cfg.pipe.latent_cache, expected)
+            self._cache_fn = self._E.make_cache_stage(cfg, self.models,
+                                                      self.mesh)
+            cached, total = self._cache_reader.coverage()
+            log.info("latent cache %s: %d/%d indices cached (misses "
+                     "re-encode live)", cfg.pipe.latent_cache, cached, total)
         # dcr-warm: pre-populate the step programs from the persistent
         # executable cache AFTER restore (the state's avals/shardings are
         # final here), so a preempted pod's first step is a cache load, not
         # a recompile
         self._warm_start()
+        if self.pipelined:
+            self._hot, self._frozen = self._E.split_state(
+                self.state, cfg.train_text_encoder)
         self.watchdog.start()
         steps_per_epoch = self.loader.steps_per_epoch()
         # All periodic cadences (log_every / save_steps / modelsavesteps /
@@ -587,188 +762,238 @@ class Trainer:
         log.info("training: %d optimizer steps (micro-batch accum %d, "
                  "%d micro/epoch), global batch %d",
                  max_sync, accum, steps_per_epoch, global_bs)
+        producer = None
         while step < max_micro:
             epoch = step // steps_per_epoch
             epoch_iter = self.loader.epoch(epoch,
                                            start_step=step % steps_per_epoch)
-            while True:
-                # span around the fetch: host time spent WAITING on the data
-                # pipeline (the loader's own decode work runs on its worker
-                # threads and is traced there as data/batch spans)
-                with tracing.span("train/data_wait", step=step):
-                    batch = next(epoch_iter, None)
-                if batch is None:
-                    break
-                if step == profile_at:
-                    try:
-                        profiling.arm(str(self.out_dir / "profile"),
-                                      profile_steps)
-                        R.log_trace("profile_armed", at_step=step,
-                                    steps=profile_steps)
-                    except (RuntimeError, ValueError) as e:
-                        R.log_event("profile_arm_failed", error=repr(e))
-                with profiling.capture():
-                    with tracing.span("train/step", step=step):
-                        sharded = pmesh.shard_batch(self.mesh, dict(batch))
-                        self.state, metrics = self._step_call(
-                            self.state, sharded, self.train_key)
-                step += 1
-                imgs_last += global_bs
-                self.watchdog.beat(step)
-                # deterministic fault-injection hooks (zero-cost when
-                # DCR_FAULTS is unset): nan_loss poisons the next observed
-                # loss; sigterm drives the real preemption path; hang wedges
-                # this host to drive the collective-hang watchdog; all accept
-                # an @rank= coordinate for single-host faults on a pod
-                if faults.fire("nan_loss", step=step):
-                    self._nan_pending = True
-                if faults.fire("sigterm", step=step):
-                    import signal as _signal
+            # dcr-pipe: in pipelined mode the producer thread owns the
+            # loader wait (train/data_wait moves to its thread) and runs the
+            # frozen-encoder stage up to pipe.depth steps ahead; the train
+            # thread's wait on the ring is the train/encode_wait bubble
+            producer = (self._make_producer(epoch_iter, start_step=step)
+                        if self.pipelined else None)
+            try:
+                while True:
+                    if producer is None:
+                        # span around the fetch: host time spent WAITING on
+                        # the data pipeline (the loader's own decode work
+                        # runs on its worker threads and is traced there as
+                        # data/batch spans)
+                        with tracing.span("train/data_wait", step=step):
+                            batch = next(epoch_iter, None)
+                        if batch is None:
+                            break
+                    else:
+                        enc = producer.get(step)
+                        if enc is None:
+                            break
+                        if flops_per_step is None:
+                            # before the step: the hot state is donated by
+                            # the call below, and lowering needs live avals
+                            flops_per_step = self._denoise_flops(enc)
+                    if step == profile_at:
+                        try:
+                            profiling.arm(str(self.out_dir / "profile"),
+                                          profile_steps)
+                            R.log_trace("profile_armed", at_step=step,
+                                        steps=profile_steps)
+                        except (RuntimeError, ValueError) as e:
+                            R.log_event("profile_arm_failed", error=repr(e))
+                    with profiling.capture():
+                        with tracing.span("train/step", step=step):
+                            if producer is None:
+                                sharded = pmesh.shard_batch(self.mesh,
+                                                            dict(batch))
+                                self.state, metrics = self._step_call(
+                                    self.state, sharded, self.train_key)
+                            else:
+                                self._hot, metrics = self._denoise_call(
+                                    self._hot, enc, self.train_key)
+                                # keep the checkpoint/export view current:
+                                # pure re-referencing of live buffers, no
+                                # copies
+                                self.state = self._E.merge_state(
+                                    self._hot, self._frozen,
+                                    cfg.train_text_encoder)
+                    step += 1
+                    imgs_last += global_bs
+                    self.watchdog.beat(step)
+                    # deterministic fault-injection hooks (zero-cost when
+                    # DCR_FAULTS is unset): nan_loss poisons the next observed
+                    # loss; sigterm drives the real preemption path; hang wedges
+                    # this host to drive the collective-hang watchdog; all accept
+                    # an @rank= coordinate for single-host faults on a pod
+                    if faults.fire("nan_loss", step=step):
+                        self._nan_pending = True
+                    if faults.fire("sigterm", step=step):
+                        import signal as _signal
 
-                    os.kill(os.getpid(), _signal.SIGTERM)
-                if faults.fire("hang", step=step):
-                    C.simulate_hang(f"injected hang at step {step}")
-                at_sync = step % accum == 0
-                sync = step // accum
-                if flops_per_step is None:
-                    flops_per_step = self._step_flops(sharded)
-                decision: Optional[C.Decision] = None
-                if (at_sync and sync % cfg.log_every == 0) or step == max_micro:
-                    metrics = jax.device_get(metrics)
-                    if self._nan_pending:
-                        metrics["loss"] = float("nan")
-                        self._nan_pending = False
-                    # ONE agreement round per boundary carries the whole fault
-                    # word (nan + preempt + bad samples). On a pod EVERY host
-                    # exchanges here even with a locally-finite loss — a
-                    # single rank's NaN must move the whole pod in lockstep,
-                    # and an un-entered collective is itself a hang. One host:
-                    # the exchange is pure local logic, entered only when a
-                    # local flag is set.
-                    nan_here = not np.isfinite(metrics["loss"])
-                    if (nan_here or getattr(self, "_preempted", False)
-                            or jax.process_count() > 1):
-                        if nan_here:
-                            self.coord.note_nan(
-                                step, rollback_ok=self._rollback_possible())
-                        if getattr(self, "_preempted", False):
-                            self.coord.note_preempt()
+                        os.kill(os.getpid(), _signal.SIGTERM)
+                    if faults.fire("hang", step=step):
+                        C.simulate_hang(f"injected hang at step {step}")
+                    at_sync = step % accum == 0
+                    sync = step // accum
+                    if flops_per_step is None and producer is None:
+                        flops_per_step = self._step_flops(sharded)
+                    decision: Optional[C.Decision] = None
+                    if (at_sync and sync % cfg.log_every == 0) or step == max_micro:
+                        metrics = jax.device_get(metrics)
+                        if self._nan_pending:
+                            metrics["loss"] = float("nan")
+                            self._nan_pending = False
+                        # ONE agreement round per boundary carries the whole fault
+                        # word (nan + preempt + bad samples). On a pod EVERY host
+                        # exchanges here even with a locally-finite loss — a
+                        # single rank's NaN must move the whole pod in lockstep,
+                        # and an un-entered collective is itself a hang. One host:
+                        # the exchange is pure local logic, entered only when a
+                        # local flag is set.
+                        nan_here = not np.isfinite(metrics["loss"])
+                        if (nan_here or getattr(self, "_preempted", False)
+                                or jax.process_count() > 1):
+                            if nan_here:
+                                self.coord.note_nan(
+                                    step, rollback_ok=self._rollback_possible())
+                            if getattr(self, "_preempted", False):
+                                self.coord.note_preempt()
+                            self.coord.note_bad_samples(self._global_bad_count())
+                            decision = self.coord.exchange(step, tag="sync")
+                            if decision.action is C.Action.ROLLBACK and \
+                                    self._rollback_after_nan(
+                                        decision.nan_step, float(metrics["loss"])):
+                                # params restored, data pointer kept at the agreed
+                                # step — the offending window is skipped; continue
+                                if producer is not None:
+                                    # re-derive the HOT view from the
+                                    # restored state but KEEP the original
+                                    # frozen buffers: the live producer's
+                                    # closure pins them (bit-equal values —
+                                    # frozen params never train), and
+                                    # re-merging over them drops the
+                                    # restore's duplicate frozen copy
+                                    # instead of holding both in HBM until
+                                    # the epoch ends
+                                    self._hot, _ = self._E.split_state(
+                                        self.state, cfg.train_text_encoder)
+                                    self.state = self._E.merge_state(
+                                        self._hot, self._frozen,
+                                        cfg.train_text_encoder)
+                                t_last, imgs_last = time.time(), 0
+                                continue
+                            if decision.action in (C.Action.ROLLBACK, C.Action.FAIL):
+                                # fail fast instead of training on garbage (the
+                                # reference has no such guard, SURVEY §5.2). Do NOT
+                                # save: params already absorbed the non-finite
+                                # update — the last periodic checkpoint is the
+                                # recovery point. All hosts raise together (same
+                                # decision), so no peer is left in a collective.
+                                self.ckpt.wait()  # flush pending async writes
+                                # fatal path: preserve the last moments (spans,
+                                # fault counters) before the raise unwinds
+                                tracing.dump_flight_recorder(
+                                    f"nan_abort: step {decision.nan_step} loss "
+                                    f"{metrics['loss']}")
+                                raise FloatingPointError(
+                                    f"non-finite loss {metrics['loss']} at step "
+                                    f"{decision.nan_step} (ranks {list(decision.nan_ranks)}); "
+                                    f"resume from the last good checkpoint "
+                                    f"(step {self.ckpt.latest_step()}) under "
+                                    f"{self.out_dir}/checkpoints")
+                        dt = time.time() - t_last
+                        metrics["images_per_sec"] = imgs_last / max(dt, 1e-9)
+                        if flops_per_step:
+                            from dcr_tpu.utils.profiling import chip_peak_tflops
+
+                            # flops_per_step is the per-chip share (post-partition
+                            # cost analysis): per-chip achieved / per-chip peak =
+                            # MFU. One naming convention with StepTimer.report:
+                            # bare tflops_per_sec is PER-DEVICE, _total is the job.
+                            steps_done = imgs_last / global_bs
+                            per_chip = flops_per_step * steps_done / max(dt, 1e-9)
+                            metrics["tflops_per_sec"] = per_chip / 1e12
+                            metrics["tflops_per_sec_total"] = (
+                                per_chip * jax.device_count() / 1e12)
+                            metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
+                        # recovery counters: no retry/rollback is ever silent —
+                        # each also logged a structured [fault] line when it fired
+                        metrics["faults/bad_samples"] = self.loader.bad_samples
+                        metrics["faults/rollbacks"] = self._rollbacks
+                        metrics["faults/ckpt_fallbacks"] = self._ckpt_fallbacks
+                        # process-wide counters bumped below the Trainer (decode
+                        # fast-path fallbacks, kv teardown/gc errors, ...)
+                        for name, count in R.counters().items():
+                            metrics[f"faults/{name}"] = count
+                        if jax.process_count() > 1:
+                            # pod-wide fault view: aggregate every host's counters
+                            # over the coordination-service KV store (pure gRPC,
+                            # timeout-bounded — no XLA collectives in the control
+                            # plane). Symmetric: every rank reaches this boundary
+                            # in lockstep, so the round can't wedge a peer.
+                            rows = dist.kv_allgather(
+                                _json.dumps(R.counters()), "fault_counters",
+                                timeout_s=dist.default_allgather_timeout_s())
+                            pod = tracing.merge_counter_rows(
+                                _json.loads(r) for r in rows)
+                            for name, count in pod.items():
+                                metrics[f"faults_pod/{name}"] = count
+                        self.writer.scalars(sync, metrics)
+                        last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
+                        t_last, imgs_last = time.time(), 0
+                    if self.sample_hook and at_sync and sync % cfg.save_steps == 0:
+                        self.sample_hook(self, sync)
+                    # single-host preemption BETWEEN log boundaries keeps the
+                    # seed's act-at-the-very-next-step behavior (pure local
+                    # "exchange", no collectives). Multi-host never enters this:
+                    # its agreement ran at the uniform log boundary above — a
+                    # local flag alone must not start a collective.
+                    if (decision is None and jax.process_count() == 1
+                            and getattr(self, "_preempted", False)):
+                        self.coord.note_preempt()
                         self.coord.note_bad_samples(self._global_bad_count())
                         decision = self.coord.exchange(step, tag="sync")
-                        if decision.action is C.Action.ROLLBACK and \
-                                self._rollback_after_nan(
-                                    decision.nan_step, float(metrics["loss"])):
-                            # params restored, data pointer kept at the agreed
-                            # step — the offending window is skipped; continue
-                            t_last, imgs_last = time.time(), 0
-                            continue
-                        if decision.action in (C.Action.ROLLBACK, C.Action.FAIL):
-                            # fail fast instead of training on garbage (the
-                            # reference has no such guard, SURVEY §5.2). Do NOT
-                            # save: params already absorbed the non-finite
-                            # update — the last periodic checkpoint is the
-                            # recovery point. All hosts raise together (same
-                            # decision), so no peer is left in a collective.
-                            self.ckpt.wait()  # flush pending async writes
-                            # fatal path: preserve the last moments (spans,
-                            # fault counters) before the raise unwinds
+                    # act on the agreed decision BEFORE the periodic save so the
+                    # same step is never written twice inside the shutdown window
+                    if decision is not None:
+                        if decision.action is C.Action.ABORT_BAD_SAMPLES:
+                            from dcr_tpu.data.loader import TooManyBadSamples
+
+                            raise TooManyBadSamples(
+                                f"epoch {epoch}: {decision.bad_total} bad samples "
+                                f"across {jax.process_count()} hosts exceed the "
+                                f"GLOBAL quarantine budget of "
+                                f"{self.coord.bad_sample_budget} "
+                                f"(max_bad_sample_frac="
+                                f"{cfg.fault.max_bad_sample_frac})")
+                        if decision.action is C.Action.CHECKPOINT_AND_EXIT:
+                            log.warning(
+                                "preemption: checkpointing at step %d and "
+                                "stopping (resume picks up here; signaled on "
+                                "ranks %s)", step, list(decision.preempt_ranks))
+                            self.save(force=True)
+                            self.ckpt.wait()
+                            if jax.process_count() > 1:
+                                log.info("state fingerprint at step %d: %s", step,
+                                         state_fingerprint(self.state))
+                            self.writer.close()
+                            self._uninstall_preemption_handler()
+                            self.watchdog.stop()
+                            self.preempted_exit = True
+                            # exit-83 path: the final checkpoint is safe; record
+                            # the run's last moments for the restart's operator
                             tracing.dump_flight_recorder(
-                                f"nan_abort: step {decision.nan_step} loss "
-                                f"{metrics['loss']}")
-                            raise FloatingPointError(
-                                f"non-finite loss {metrics['loss']} at step "
-                                f"{decision.nan_step} (ranks {list(decision.nan_ranks)}); "
-                                f"resume from the last good checkpoint "
-                                f"(step {self.ckpt.latest_step()}) under "
-                                f"{self.out_dir}/checkpoints")
-                    dt = time.time() - t_last
-                    metrics["images_per_sec"] = imgs_last / max(dt, 1e-9)
-                    if flops_per_step:
-                        from dcr_tpu.utils.profiling import chip_peak_tflops
-
-                        # flops_per_step is the per-chip share (post-partition
-                        # cost analysis): per-chip achieved / per-chip peak =
-                        # MFU. One naming convention with StepTimer.report:
-                        # bare tflops_per_sec is PER-DEVICE, _total is the job.
-                        steps_done = imgs_last / global_bs
-                        per_chip = flops_per_step * steps_done / max(dt, 1e-9)
-                        metrics["tflops_per_sec"] = per_chip / 1e12
-                        metrics["tflops_per_sec_total"] = (
-                            per_chip * jax.device_count() / 1e12)
-                        metrics["mfu"] = per_chip / 1e12 / chip_peak_tflops()
-                    # recovery counters: no retry/rollback is ever silent —
-                    # each also logged a structured [fault] line when it fired
-                    metrics["faults/bad_samples"] = self.loader.bad_samples
-                    metrics["faults/rollbacks"] = self._rollbacks
-                    metrics["faults/ckpt_fallbacks"] = self._ckpt_fallbacks
-                    # process-wide counters bumped below the Trainer (decode
-                    # fast-path fallbacks, kv teardown/gc errors, ...)
-                    for name, count in R.counters().items():
-                        metrics[f"faults/{name}"] = count
-                    if jax.process_count() > 1:
-                        # pod-wide fault view: aggregate every host's counters
-                        # over the coordination-service KV store (pure gRPC,
-                        # timeout-bounded — no XLA collectives in the control
-                        # plane). Symmetric: every rank reaches this boundary
-                        # in lockstep, so the round can't wedge a peer.
-                        rows = dist.kv_allgather(
-                            _json.dumps(R.counters()), "fault_counters",
-                            timeout_s=dist.default_allgather_timeout_s())
-                        pod = tracing.merge_counter_rows(
-                            _json.loads(r) for r in rows)
-                        for name, count in pod.items():
-                            metrics[f"faults_pod/{name}"] = count
-                    self.writer.scalars(sync, metrics)
-                    last_metrics = {k: float(np.asarray(v)) for k, v in metrics.items()}
-                    t_last, imgs_last = time.time(), 0
-                if self.sample_hook and at_sync and sync % cfg.save_steps == 0:
-                    self.sample_hook(self, sync)
-                # single-host preemption BETWEEN log boundaries keeps the
-                # seed's act-at-the-very-next-step behavior (pure local
-                # "exchange", no collectives). Multi-host never enters this:
-                # its agreement ran at the uniform log boundary above — a
-                # local flag alone must not start a collective.
-                if (decision is None and jax.process_count() == 1
-                        and getattr(self, "_preempted", False)):
-                    self.coord.note_preempt()
-                    self.coord.note_bad_samples(self._global_bad_count())
-                    decision = self.coord.exchange(step, tag="sync")
-                # act on the agreed decision BEFORE the periodic save so the
-                # same step is never written twice inside the shutdown window
-                if decision is not None:
-                    if decision.action is C.Action.ABORT_BAD_SAMPLES:
-                        from dcr_tpu.data.loader import TooManyBadSamples
-
-                        raise TooManyBadSamples(
-                            f"epoch {epoch}: {decision.bad_total} bad samples "
-                            f"across {jax.process_count()} hosts exceed the "
-                            f"GLOBAL quarantine budget of "
-                            f"{self.coord.bad_sample_budget} "
-                            f"(max_bad_sample_frac="
-                            f"{cfg.fault.max_bad_sample_frac})")
-                    if decision.action is C.Action.CHECKPOINT_AND_EXIT:
-                        log.warning(
-                            "preemption: checkpointing at step %d and "
-                            "stopping (resume picks up here; signaled on "
-                            "ranks %s)", step, list(decision.preempt_ranks))
-                        self.save(force=True)
-                        self.ckpt.wait()
-                        if jax.process_count() > 1:
-                            log.info("state fingerprint at step %d: %s", step,
-                                     state_fingerprint(self.state))
-                        self.writer.close()
-                        self._uninstall_preemption_handler()
-                        self.watchdog.stop()
-                        self.preempted_exit = True
-                        # exit-83 path: the final checkpoint is safe; record
-                        # the run's last moments for the restart's operator
-                        tracing.dump_flight_recorder(
-                            f"preempted: checkpointed at step {step}")
-                        return last_metrics
-                if at_sync and sync % cfg.modelsavesteps == 0:
-                    self.save()
-                if step >= max_micro:
-                    break
+                                f"preempted: checkpointed at step {step}")
+                            return last_metrics
+                    if at_sync and sync % cfg.modelsavesteps == 0:
+                        self.save()
+                    if step >= max_micro:
+                        break
+            finally:
+                # every exit path — epoch end, preemption return, NaN abort,
+                # loader error — must tear the producer down promptly so no
+                # daemon thread is left dispatching device programs
+                if producer is not None:
+                    producer.stop()
         self.watchdog.stop()  # export/teardown below has no step heartbeat
         self.save(force=True)
         self.ckpt.wait()

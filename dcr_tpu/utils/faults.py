@@ -42,6 +42,14 @@ coordinated-recovery tests. Supported kinds and their hook points:
   ``warmcache/*`` fault counter, and a clean recompile. This is how CI
   proves a poisoned executable cache can never crash a boot or load a
   wrong program. ``cache_corrupt@load=0`` poisons the first load.
+- ``latent_cache_corrupt`` — latent-cache shard load (data/latent_cache.py),
+  coord ``load`` (per-reader shard read index): damages the just-read shard
+  bytes in memory so the sha verification fails exactly like real bit rot —
+  the shard is quarantine-renamed, a ``latentcache/shard_corrupt`` counter
+  bumps, and its indices degrade to cache misses that the pipelined
+  producer re-encodes live (``latentcache/batch_recompute``). This is how
+  CI proves a damaged latent cache can never crash a run or train on wrong
+  latents. ``latent_cache_corrupt@load=0`` poisons the first shard.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

@@ -680,6 +680,9 @@ def test_repo_registered_surfaces_match_expectations():
     assert surfaces == {
         "train/step": True,
         "train/params_finite": True,
+        "train/encode": True,          # dcr-pipe producer stage
+        "train/encode_cached": True,   # dcr-pipe latent-cache stage
+        "train/denoise": True,         # dcr-pipe denoiser hot step
         "serve/batch_sampler": True,
         "serve/encode": True,
         "sample/sampler": True,
@@ -700,6 +703,11 @@ def test_checked_in_manifest_covers_acceptance_surfaces():
     # both/all samplers (plus the dcr-fast score-reuse variants at the
     # default operating point), eval embed step
     assert "default" in by_surface["train/step"]
+    # dcr-pipe: producer (live + precompute-moments variants), denoiser hot
+    # step, and the latent-cache stage are all fingerprinted
+    assert by_surface["train/encode"] == {"default", "moments"}
+    assert "default" in by_surface["train/denoise"]
+    assert "default" in by_surface["train/encode_cached"]
     assert by_surface["serve/batch_sampler"] == {"ddim", "dpm++", "ddpm",
                                                  "dpm++-fast"}
     assert by_surface["sample/sampler"] == {"ddim", "dpm++", "ddpm",

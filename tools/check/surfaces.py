@@ -114,6 +114,119 @@ def _build_train_step() -> dict:
         })
 
 
+def _pipe_statics(cfg) -> dict:
+    """The static knobs the dcr-pipe programs bake in (the fused step's
+    list minus what each stage doesn't touch, kept uniform for readability)."""
+    return {
+        "mixed_precision": cfg.mixed_precision,
+        "remat": cfg.remat,
+        "train_text_encoder": cfg.train_text_encoder,
+        "ema_decay": cfg.ema_decay,
+        "rand_noise_lam": cfg.rand_noise_lam,
+        "mixup_noise_lam": cfg.mixup_noise_lam,
+        "gradient_accumulation_steps":
+            cfg.optim.gradient_accumulation_steps,
+        "use_8bit_adam": cfg.optim.use_8bit_adam,
+        "max_grad_norm": cfg.optim.max_grad_norm,
+        "train_batch_size": cfg.train_batch_size,
+    }
+
+
+def _pipe_batch_avals(cfg) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    bsz = cfg.train_batch_size
+    px = _pixels(cfg)
+    return {
+        "pixel_values": jax.ShapeDtypeStruct((bsz, px, px, 3), jnp.float32),
+        "input_ids": jax.ShapeDtypeStruct(
+            (bsz, cfg.model.text_max_length), jnp.int32),
+        "index": jax.ShapeDtypeStruct(
+            (bsz,), jax.dtypes.canonicalize_dtype(jnp.int64)),
+    }
+
+
+def _build_encode_stage(emit: str = "latents") -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.diffusion import encode_stage as E
+    from dcr_tpu.diffusion.trainer import abstract_train_state, build_modules
+
+    cfg = _tiny_train_cfg()
+    mesh = _mesh1()
+    models = build_modules(cfg)
+    _, frozen = E.split_state(abstract_train_state(cfg),
+                              cfg.train_text_encoder)
+    fn = E.make_encode_stage(cfg, models, mesh, emit=emit)
+    step = jax.ShapeDtypeStruct((), jnp.uint32)
+    return dict(
+        fn=fn, args=(frozen, _pipe_batch_avals(cfg), rngmod.root_key(0),
+                     step),
+        static_config=dict(_pipe_statics(cfg), emit=emit))
+
+
+def _enc_avals(cfg) -> dict:
+    """The encoded-batch pytree the denoiser consumes (encode-stage output
+    contract; trainer._enc_avals is the production twin)."""
+    import jax
+    import jax.numpy as jnp
+
+    bsz = cfg.train_batch_size
+    lat = cfg.model.sample_size
+    return {
+        "latents": jax.ShapeDtypeStruct(
+            (bsz, lat, lat, cfg.model.vae_latent_channels), jnp.float32),
+        "ctx": jax.ShapeDtypeStruct(
+            (bsz, cfg.model.text_max_length, cfg.model.text_hidden_size),
+            jnp.float32),
+        "index": jax.ShapeDtypeStruct(
+            (bsz,), jax.dtypes.canonicalize_dtype(jnp.int64)),
+    }
+
+
+def _build_denoise_step() -> dict:
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.diffusion import encode_stage as E
+    from dcr_tpu.diffusion.trainer import abstract_train_state, build_modules
+
+    cfg = _tiny_train_cfg()
+    mesh = _mesh1()
+    models = build_modules(cfg)
+    hot, _ = E.split_state(abstract_train_state(cfg), cfg.train_text_encoder)
+    fn = E.make_denoise_step(cfg, models, mesh)
+    return dict(
+        fn=fn, args=(hot, _enc_avals(cfg), rngmod.root_key(0)),
+        donate_argnums=(0,), static_config=_pipe_statics(cfg))
+
+
+def _build_cache_stage() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import rng as rngmod
+    from dcr_tpu.diffusion import encode_stage as E
+    from dcr_tpu.diffusion.trainer import build_modules
+
+    cfg = _tiny_train_cfg()
+    mesh = _mesh1()
+    models = build_modules(cfg)
+    fn = E.make_cache_stage(cfg, models, mesh)
+    enc = _enc_avals(cfg)
+    moment = jax.ShapeDtypeStruct(
+        (cfg.train_batch_size, cfg.model.sample_size, cfg.model.sample_size,
+         cfg.model.vae_latent_channels), jnp.float32)
+    moments = {"mean": moment, "std": moment, "ctx": enc["ctx"],
+               "index": enc["index"]}
+    step = jax.ShapeDtypeStruct((), jnp.uint32)
+    return dict(
+        fn=fn, args=(moments, rngmod.root_key(0), step),
+        static_config=dict(_pipe_statics(cfg),
+                           vae_scaling_factor=cfg.model.vae_scaling_factor))
+
+
 def _build_params_finite() -> dict:
     from dcr_tpu.diffusion import train as T
     from dcr_tpu.diffusion.trainer import _params_finite, abstract_train_state
@@ -285,6 +398,17 @@ SURFACES: tuple[SurfaceSpec, ...] = (
                 _build_train_step),
     SurfaceSpec("train/params_finite@default", "train/params_finite",
                 "default", _build_params_finite),
+    # dcr-pipe: the pipelined-training split. The fused train/step@default
+    # entry above is the pipelined-OFF program — its digest moving would
+    # mean the disabled path is no longer bit-identical to the seed.
+    SurfaceSpec("train/encode@default", "train/encode", "default",
+                _build_encode_stage),
+    SurfaceSpec("train/encode@moments", "train/encode", "moments",
+                lambda: _build_encode_stage("moments")),
+    SurfaceSpec("train/denoise@default", "train/denoise", "default",
+                _build_denoise_step),
+    SurfaceSpec("train/encode_cached@default", "train/encode_cached",
+                "default", _build_cache_stage),
     *(SurfaceSpec(f"serve/batch_sampler@{s}", "serve/batch_sampler", s,
                   (lambda s=s: _build_serve_bucket(s))) for s in SAMPLERS),
     *(SurfaceSpec(f"sample/sampler@{s}", "sample/sampler", s,

@@ -383,6 +383,74 @@ def copy_risk_summary(records: list[dict]) -> dict | None:
     }
 
 
+def _interval_overlap_us(a: list[tuple[float, float]],
+                         b: list[tuple[float, float]]) -> float:
+    """Total pairwise intersection of two interval lists (start, end),
+    linear merge over the sorted lists — the encode-vs-denoise overlap."""
+    a = sorted(a)
+    b = sorted(b)
+    i = j = 0
+    total = 0.0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def pipeline_summary(records: list[dict]) -> dict | None:
+    """The "Pipeline" section (dcr-pipe): how well the frozen-encoder
+    producer stage overlaps the denoiser hot loop. Built from the
+    ``train/encode`` spans (producer thread), ``train/step`` spans (the
+    denoiser in pipelined runs), and ``train/encode_wait`` spans (the train
+    thread blocked on the prefetch ring — the pipeline bubble). None when
+    nothing was pipelined — fused traces keep their old report shape.
+
+    - ``bubble_pct``: encode_wait time over (encode_wait + step) time — the
+      fraction of the hot loop spent stalled on the producer;
+    - ``overlap_pct``: wall-clock intersection of encode spans with step
+      spans over total encode time — how much encoder work genuinely hid
+      behind the denoiser (≈0 on a single-core host, where the win comes
+      from the latent cache instead);
+    - ``data_wait``: the producer's own stall on the host loader, to tell a
+      loader-bound pipeline from an encode-bound one.
+    """
+    encode = [r for r in records
+              if r["ph"] == "X" and r["name"] == "train/encode"]
+    if not encode:
+        return None
+    waits = [r["dur"] / 1e3 for r in records
+             if r["ph"] == "X" and r["name"] == "train/encode_wait"]
+    steps = [r for r in records
+             if r["ph"] == "X" and r["name"] == "train/step"]
+    data_waits = [r["dur"] / 1e3 for r in records
+                  if r["ph"] == "X" and r["name"] == "train/data_wait"]
+    encode_ms = sum(r["dur"] for r in encode) / 1e3
+    step_ms = sum(r["dur"] for r in steps) / 1e3
+    wait_ms = sum(waits)
+    overlap_ms = _interval_overlap_us(
+        [(r["ts"], r["ts"] + r["dur"]) for r in encode],
+        [(r["ts"], r["ts"] + r["dur"]) for r in steps]) / 1e3
+    waits_sorted = sorted(waits)
+    return {
+        "encoded_batches": len(encode),
+        "encode_total_ms": round(encode_ms, 3),
+        "denoise_total_ms": round(step_ms, 3),
+        "encode_wait_total_ms": round(wait_ms, 3),
+        "data_wait_total_ms": round(sum(data_waits), 3),
+        "bubble_pct": round(100 * wait_ms / max(wait_ms + step_ms, 1e-9), 2),
+        "overlap_ms": round(overlap_ms, 3),
+        "overlap_pct": round(100 * overlap_ms / max(encode_ms, 1e-9), 2),
+        "encode_wait_p50_ms": round(_percentile(waits_sorted, 50), 3),
+        "encode_wait_p99_ms": round(_percentile(waits_sorted, 99), 3),
+    }
+
+
 def fast_sampling_summary(records: list[dict]) -> dict | None:
     """The "Fast sampling" section (dcr-fast): denoiser-call reduction from
     ``sample/fast`` spans — one per accelerated batch EXECUTION, carrying
@@ -515,6 +583,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "compiles_per_incarnation": compiles_per_incarnation(records),
         "copy_risk": copy_risk_summary(records),
         "fast_sampling": fast_sampling_summary(records),
+        "pipeline": pipeline_summary(records),
         "fault_timeline": faults,
         "fleet": fleet_summary(records, meta or {}),
     }
@@ -604,6 +673,18 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         lines.append("XLA compiles per process incarnation:")
         for inc, n in summary["compiles_per_incarnation"].items():
             lines.append(f"  {n}x {inc}")
+    pipe = summary.get("pipeline")
+    if pipe:
+        lines.append(
+            f"\npipeline: {pipe['encoded_batches']} batch(es) through the "
+            f"encoder producer — bubble {pipe['bubble_pct']}% "
+            f"(encode_wait {pipe['encode_wait_total_ms']} ms vs denoise "
+            f"{pipe['denoise_total_ms']} ms), encode-vs-denoise overlap "
+            f"{pipe['overlap_pct']}% of {pipe['encode_total_ms']} ms encode")
+        lines.append(
+            f"  encode_wait p50 {pipe['encode_wait_p50_ms']} ms  "
+            f"p99 {pipe['encode_wait_p99_ms']} ms  "
+            f"producer data_wait {pipe['data_wait_total_ms']} ms")
     fast = summary.get("fast_sampling")
     if fast:
         lines.append(
