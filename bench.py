@@ -369,12 +369,13 @@ def flops_cpu_hlo(jax, batch_size: int, resolution: int) -> float:
                     (ref_bs, cfg.model.text_max_length), jax.numpy.int32),
             }
             key_abs = jax.eval_shape(lambda: jax.random.key(0))
+            from dcr_tpu.obs.memwatch import flops_of_compiled
+
             lowered = T.make_train_step(cfg, models, mesh).lower(
                 state_abs, batch_abs, key_abs)
-            cost = lowered.cost_analysis()
-            if isinstance(cost, list):
-                cost = cost[0]
-            flops = float(cost.get("flops", 0.0))
+            # one shared cost_analysis extraction (obs/memwatch) — the same
+            # helper the StepTimer MFU numbers flow through
+            flops = flops_of_compiled(lowered)
     except Exception as e:
         mark("cpu_flops_error", error=repr(e)[:300])
         flops = 0.0
@@ -426,13 +427,11 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
     # then drive the compiled executable — lets us read post-compile per-chip
     # cost analysis without a second compile.
     def _flops_of(obj) -> float:
-        try:
-            cost = obj.cost_analysis()
-            if isinstance(cost, list):
-                cost = cost[0]
-            return float(cost.get("flops", 0.0)) / n_dev
-        except Exception:
-            return 0.0
+        from dcr_tpu.obs.memwatch import flops_of_compiled
+
+        # shared extraction; this rung wants the per-chip share of the
+        # whole-job lowering, hence the device divide the helper doesn't do
+        return flops_of_compiled(obj) / n_dev
 
     lowered = step_fn.lower(state, batch, key)
     flops_lowered = _flops_of(lowered)
@@ -499,6 +498,8 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
     imgs = bsz / dt / n_dev
     peak = profiling.chip_peak_tflops() * 1e12
     mfu = (flops / dt) / peak if flops and peak > 1e12 else None
+    from dcr_tpu.obs.memwatch import peak_bytes
+
     result = {"bs": batch_size, "px": resolution, "flash": flash,
               "images_per_sec_per_chip": round(imgs, 3),
               "step_ms": round(dt * 1e3, 1),
@@ -507,7 +508,12 @@ def bench_rung(jax, batch_size: int, dog: Watchdog, steps: int = 10,
               "flops_method": method,
               "gflops_per_step_chip": round(flops / 1e9, 1),
               "remat": remat,
-              "loss": round(float(m["loss"]), 4)}
+              "loss": round(float(m["loss"]), 4),
+              # dcr-hbm: process high-water mark after this rung's steps
+              # (null on backends without memory_stats, e.g. XLA:CPU).
+              # Monotonic across the rungs of one bench process — read
+              # rung-to-rung steps, not absolute per-rung peaks.
+              "hbm_peak_bytes": peak_bytes()}
     # tail-aware step time: individually-synced steps through a LatencyTracker
     # reservoir, so the BENCH trail records p50/p99 alongside the slope mean —
     # a mean hides exactly the stragglers (recompiles, host stalls, tunnel

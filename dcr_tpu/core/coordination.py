@@ -53,7 +53,11 @@ log = logging.getLogger("dcr_tpu")
 # reserved ranges (1/2, 126-165) so they are unambiguous in `$?`:
 # EXIT_PREEMPTED means "final checkpoint written, restart me";
 # EXIT_HANG means "a collective hung — inspect the stack dump, then restart".
+# EXIT_OOM means "XLA RESOURCE_EXHAUSTED — the flight-recorder dump carries
+# the memory snapshot and live-surface footprints (obs/memwatch.py); the
+# fleet supervisor treats it like a crash (requeue + respawn)".
 EXIT_PREEMPTED = 83
+EXIT_OOM = 85
 EXIT_HANG = 89
 
 # monkeypatchable so tests can observe aborts without dying

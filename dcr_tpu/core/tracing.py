@@ -574,13 +574,17 @@ def flight_records() -> list[dict]:
 
 
 def dump_flight_recorder(reason: str, *,
-                         directory: Optional[str | Path] = None) -> Optional[Path]:
+                         directory: Optional[str | Path] = None,
+                         extra: Optional[dict] = None) -> Optional[Path]:
     """Write ``flightrec_<rank>.json`` — the last N spans/events, a registry
-    snapshot and the abort reason — to ``directory`` (default: the configured
-    trace dir, else ``DCR_FLIGHTREC_DIR``). The post-mortem for every fatal
-    path: NaN abort, watchdog exit 89, preemption exit 83, unhandled
-    exceptions. Never raises (it runs while the process is dying); returns
-    None when no destination is configured or the write fails.
+    snapshot, a best-effort device-memory snapshot and the abort reason — to
+    ``directory`` (default: the configured trace dir, else
+    ``DCR_FLIGHTREC_DIR``). The post-mortem for every fatal path: NaN abort,
+    watchdog exit 89, preemption exit 83, OOM exit 85, unhandled exceptions.
+    Never raises (it runs while the process is dying); returns None when no
+    destination is configured or the write fails. ``extra`` merges
+    caller-supplied forensic sections into the document (the OOM path ships
+    its enriched memory/footprint/bucket view through it).
 
     First dump wins: the record closest to the fault is the post-mortem of
     record — a NaN abort's explicit dump must not be overwritten by the
@@ -598,14 +602,26 @@ def dump_flight_recorder(reason: str, *,
     name = (f"flightrec_{rank}.json" if widx is None
             else f"flightrec_w{widx}_{rank}.json")
     path = Path(d) / name
+    # best-effort memory forensics on EVERY fatal path, not just OOM: a NaN
+    # abort or hang post-mortem answering "how full was the device" for free
+    # is the whole point of having the sampler machinery resident
+    try:
+        from dcr_tpu.obs import memwatch
+
+        memory = memwatch.memory_snapshot_doc()
+    except Exception as e:  # the dump must survive a broken accounting layer
+        log.warning("[trace] flightrec_memory_snapshot_failed %r", e)
+        memory = None
     doc = {
         "version": TRACE_VERSION,
         "reason": reason,
         "time": time.time(),
         "rank": rank,
         "os_pid": os.getpid(),
+        "memory": memory,
         "records": flight_records(),
         "registry": _registry.snapshot(),
+        **(extra or {}),
     }
     try:
         path.parent.mkdir(parents=True, exist_ok=True)

@@ -320,6 +320,10 @@ class WarmResult:
     lower_s: float               # AOT lowering time
     build_s: float               # compile (cold) or deserialize (warm) time
     entry: Optional[Path] = None
+    # XLA memory_analysis of the resolved program (obs/memwatch.memory_block;
+    # None where the backend/object offers none) — the per-surface HBM
+    # footprint the OOM forensics and serve admission estimates read
+    memory: Optional[dict] = None
 
 
 class WarmCache:
@@ -484,6 +488,22 @@ class WarmCache:
 # The one entry point call sites use
 # ---------------------------------------------------------------------------
 
+def _surface_memory(surface: str, key: str, compiled) -> Optional[dict]:
+    """dcr-hbm static accounting at AOT time: the program's XLA memory
+    analysis (None-safe) lands in the process's live-surface registry — the
+    footprints an OOM dump carries and the serve admission estimate reads —
+    plus one ``memwatch/surface_memory`` trace event for trace_report's
+    "Memory" section. Lazy import: core must not pull obs at import time."""
+    from dcr_tpu.obs import memwatch
+
+    mem = memwatch.memory_block(compiled)
+    if mem is not None:
+        memwatch.note_surface(surface, key, mem)
+        tracing.event("memwatch/surface_memory", surface=surface, key=key,
+                      os_pid=os.getpid(), attrs=mem)
+    return mem
+
+
 def aot_compile(surface: str, jit_fn, args: tuple, *,
                 static_config: Optional[dict] = None,
                 cache: Optional[WarmCache] = None) -> WarmResult:
@@ -511,13 +531,15 @@ def aot_compile(surface: str, jit_fn, args: tuple, *,
             return WarmResult(fn=fn, source="cache", surface=surface,
                               key=key, lower_s=lower_s,
                               build_s=time.monotonic() - t1,
-                              entry=cache.entry_path(surface, key))
+                              entry=cache.entry_path(surface, key),
+                              memory=_surface_memory(surface, key, fn))
         cache.counter("misses").inc()
     t1 = time.monotonic()
     with tracing.span("warmcache/compile", surface=surface, key=key,
                       os_pid=os.getpid()):
         compiled = lowered.compile()
     build_s = time.monotonic() - t1
+    mem = _surface_memory(surface, key, compiled)
     entry = None
     if cache is not None:
         tier = active_tier()
@@ -548,7 +570,8 @@ def aot_compile(surface: str, jit_fn, args: tuple, *,
         if payload is not None:
             entry = cache.store(surface, key, fp, tier, payload)
     return WarmResult(fn=compiled, source="compiled", surface=surface,
-                      key=key, lower_s=lower_s, build_s=build_s, entry=entry)
+                      key=key, lower_s=lower_s, build_s=build_s, entry=entry,
+                      memory=mem)
 
 
 def guarded(fast_fn: Callable, fallback: Callable, surface: str) -> Callable:

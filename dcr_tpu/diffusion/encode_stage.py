@@ -356,8 +356,13 @@ class EncodeProducer:
                     batch = next(self._source, None)
                 if batch is None:
                     break
-                with tracing.span("train/encode", step=step):
-                    enc = self._encode(batch, step)
+                with tracing.span("train/encode", step=step) as sp:
+                    # dcr-hbm: hbm_peak/hbm_delta attrs on the producer's
+                    # hot region (no-op where the backend has no stats)
+                    from dcr_tpu.obs import memwatch
+
+                    with memwatch.span_hbm(sp):
+                        enc = self._encode(batch, step)
                 if not self._safe_put((step, enc, None)):
                     return
                 step += 1

@@ -41,6 +41,7 @@ from dcr_tpu.models import schedulers as S
 from dcr_tpu.models.clip_text import init_clip_text
 from dcr_tpu.models.unet2d import init_unet
 from dcr_tpu.models.vae import init_vae, vae_scale_factor
+from dcr_tpu.obs import memwatch
 from dcr_tpu.parallel import mesh as pmesh
 
 log = logging.getLogger("dcr_tpu")
@@ -180,6 +181,9 @@ class Trainer:
         # run dir (DCR_TRACE=0 keeps the flight-recorder ring only), and the
         # anchor for flightrec_<rank>.json on every fatal path
         tracing.configure(self.out_dir, rank=pidx)
+        # dcr-hbm: periodic device.memory_stats() -> dcr_device_mem_* gauges
+        # (graceful no-op on backends that report none, e.g. XLA:CPU)
+        memwatch.start_sampler()
         self.dataset = dataset or ObjectAttributeDataset(
             cfg.data, self.tokenizer, fault=cfg.fault)
         # train_batch_size is per-device (reference semantics: per-GPU batch ×
@@ -684,6 +688,23 @@ class Trainer:
     def train(self) -> dict:
         try:
             return self._train_impl()
+        except Exception as e:
+            # dcr-hbm: XLA RESOURCE_EXHAUSTED anywhere in the loop (step,
+            # encode producer, restore) becomes the typed OOM fatal path —
+            # a flight-recorder dump enriched with the device-memory
+            # snapshot and live-surface footprints, then exit 85, so a
+            # restart wrapper can tell "shrink the batch" apart from a
+            # crash. Every other exception keeps its existing semantics.
+            if memwatch.is_oom_error(e):
+                self.watchdog.stop()
+                try:
+                    at = int(jax.device_get(self.state.step))
+                except Exception:  # state buffers may be donated/deleted
+                    # mid-step when the allocator failed — the dump's last
+                    # spans carry the step anyway
+                    at = -1
+                memwatch.oom_abort(f"train step {at}", e)
+            raise
         finally:
             # watchdog must die with the loop on EVERY exit path: a fail-fast
             # exception (FloatingPointError, TooManyBadSamples, loader errors)
@@ -801,7 +822,11 @@ class Trainer:
                         except (RuntimeError, ValueError) as e:
                             R.log_event("profile_arm_failed", error=repr(e))
                     with profiling.capture():
-                        with tracing.span("train/step", step=step):
+                        # dcr-hbm: hbm_peak/hbm_delta span attrs (no-op on
+                        # stats-less backends) — trace_report's Memory
+                        # section aggregates resident deltas from these
+                        with tracing.span("train/step", step=step) as sp, \
+                                memwatch.span_hbm(sp):
                             if producer is None:
                                 sharded = pmesh.shard_batch(self.mesh,
                                                             dict(batch))
@@ -826,6 +851,11 @@ class Trainer:
                     # an @rank= coordinate for single-host faults on a pod
                     if faults.fire("nan_loss", step=step):
                         self._nan_pending = True
+                    if faults.fire("oom", step=step):
+                        # deterministic RESOURCE_EXHAUSTED: propagates to
+                        # train()'s OOM catch exactly like the real thing
+                        # (memory-enriched flight-rec dump, exit 85)
+                        raise memwatch.InjectedOom(f"train step {step}")
                     if faults.fire("sigterm", step=step):
                         import signal as _signal
 

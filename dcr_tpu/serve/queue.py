@@ -45,6 +45,14 @@ class BucketLimitError(AdmissionError):
     parameters could grow device/host memory without limit."""
 
 
+class MemoryBudgetError(AdmissionError):
+    """Admitting this request's NOVEL bucket would compile a resident
+    program whose estimated footprint (from the live surfaces' XLA memory
+    analysis, obs/memwatch.py) exceeds remaining device memory (HTTP 503).
+    The containment that keeps one adversarial bucket request from OOMing a
+    warm worker; buckets already resident are unaffected."""
+
+
 class SloShedError(AdmissionError):
     """The fleet is shedding load: queue-wait p99 breached the configured SLO
     while a backlog exists (HTTP 503 with a Retry-After hint). Distinct from
@@ -179,6 +187,14 @@ class RequestQueue:
     def depth(self) -> int:
         with self._cond:
             return len(self._items)
+
+    def has_bucket(self, bucket: GenBucket) -> bool:
+        """Whether any PENDING request carries ``bucket`` — the admission
+        rollback's guard: a bucket another thread's queued request still
+        references must keep its resident-program slot (and its dcr-hbm
+        byte reservation) registered."""
+        with self._cond:
+            return any(r.bucket == bucket for r in self._items)
 
     def empty(self) -> bool:
         return self.depth() == 0

@@ -49,8 +49,8 @@ from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.sampling import fastsample
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket,
-                                 InvalidRequestError, NoWorkersError,
-                                 QueueFullError, SloShedError)
+                                 InvalidRequestError, MemoryBudgetError,
+                                 NoWorkersError, QueueFullError, SloShedError)
 from dcr_tpu.serve.worker import MAX_STEPS, GenerationService
 
 log = logging.getLogger("dcr_tpu")
@@ -66,6 +66,7 @@ _ADMISSION_RESPONSES = (
     (InvalidRequestError, 400, "bad_request"),
     (QueueFullError, 503, "overloaded"),
     (BucketLimitError, 503, "bucket_limit"),
+    (MemoryBudgetError, 503, "memory_budget"),
     (DrainingError, 503, "draining"),
     (SloShedError, 503, "shed"),
     (NoWorkersError, 503, "no_workers"),

@@ -57,6 +57,7 @@ from typing import Callable, Optional
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig, to_dict
+from dcr_tpu.core.coordination import EXIT_OOM
 from dcr_tpu.core.metrics import LatencyTracker
 from dcr_tpu.serve.batcher import Batcher
 from dcr_tpu.serve.fleet import (FleetPaths, RequestJournal, WorkerLease,
@@ -486,6 +487,17 @@ class FleetSupervisor:
         R.log_event("fleet_spawn_failed", worker=slot.index, reason=reason,
                     retired=retire)
 
+    @staticmethod
+    def _rc_reason(rc: int) -> str:
+        """Name the typed exit codes in death reasons: an OOM (85) is
+        handled exactly like any crash — requeue + respawn — but the
+        operator-facing reason should say where the post-mortem is."""
+        if rc == EXIT_OOM:
+            return (f"worker OOM (exit {rc} EXIT_OOM — its flight-recorder "
+                    "dump carries the memory snapshot and live-surface "
+                    "footprints)")
+        return f"process exited rc={rc}"
+
     def _monitor_loop(self) -> None:
         while not self._shutdown.wait(self._poll_s):
             now = time.time()
@@ -496,7 +508,7 @@ class FleetSupervisor:
                     rc = slot.proc.poll()
                     lease = read_lease(self.paths, slot.index)
                     if rc is not None:
-                        self._worker_failed(slot, f"process exited rc={rc}")
+                        self._worker_failed(slot, self._rc_reason(rc))
                     elif lease is None or lease.expired(now):
                         age = lease.age_s(now) if lease is not None else None
                         self._worker_failed(
@@ -529,8 +541,8 @@ class FleetSupervisor:
                         alive += 1
                     elif rc is not None:
                         self._spawn_failed(
-                            slot, f"exited rc={rc} before publishing a "
-                            "ready lease")
+                            slot, f"{self._rc_reason(rc)} before publishing "
+                            "a ready lease")
                     elif now > slot.spawn_deadline:
                         self._spawn_failed(slot, "no ready lease within "
                                            f"{self.cfg.fleet.spawn_timeout_s}s"

@@ -44,6 +44,7 @@ from dcr_tpu.core.config import ServeConfig
 from dcr_tpu.core.metrics import LatencyTracker, MetricWriter
 from dcr_tpu.models import schedulers as S
 from dcr_tpu.models.vae import vae_scale_factor
+from dcr_tpu.obs import memwatch
 from dcr_tpu.sampling import fastsample
 from dcr_tpu.sampling.pipeline import GenerationStack
 from dcr_tpu.sampling.sampler import fast_plan_grid, scheduler_step
@@ -51,7 +52,8 @@ from dcr_tpu.serve.batcher import Batcher
 from dcr_tpu.serve.cache import EmbeddingCache, embedding_key, mitigation_tag
 from dcr_tpu.serve.queue import (AdmissionError, BucketLimitError,
                                  DrainingError, GenBucket,
-                                 InvalidRequestError, Request, RequestQueue)
+                                 InvalidRequestError, MemoryBudgetError,
+                                 Request, RequestQueue)
 from dcr_tpu.utils import profiling
 
 log = logging.getLogger("dcr_tpu")
@@ -218,6 +220,7 @@ class ServeMetrics:
         self.rejected_draining = 0
         self.rejected_invalid = 0
         self.rejected_bucket_limit = 0
+        self.rejected_memory_budget = 0
         self.completed_total = 0
         self.failed_total = 0
         self.batches_total = 0
@@ -240,6 +243,8 @@ class ServeMetrics:
                 self.rejected_invalid += 1
             elif isinstance(error, BucketLimitError):
                 self.rejected_bucket_limit += 1
+            elif isinstance(error, MemoryBudgetError):
+                self.rejected_memory_budget += 1
             else:
                 self.rejected_overload += 1
 
@@ -264,6 +269,7 @@ class ServeMetrics:
                 "rejected_draining": self.rejected_draining,
                 "rejected_invalid": self.rejected_invalid,
                 "rejected_bucket_limit": self.rejected_bucket_limit,
+                "rejected_memory_budget": self.rejected_memory_budget,
                 "completed_total": self.completed_total,
                 "failed_total": self.failed_total,
                 "batches_total": batches,
@@ -305,6 +311,9 @@ class GenerationService:
         # a misconfigured default bucket must fail at STARTUP, not boot a
         # healthy-looking replica that 400s every default request
         validate_bucket(self.default_bucket(), vae_scale=self._vae_scale)
+        # dcr-hbm: live dcr_device_mem_* gauges for /metrics and the fleet
+        # scrape (graceful no-op where the backend reports no stats)
+        memwatch.start_sampler()
         # persistent executable cache (dcr-warm): compiled samplers/encoder
         # are loaded from disk when a verified entry exists, so a respawn
         # reaches ready without paying XLA again
@@ -377,14 +386,21 @@ class GenerationService:
         try:
             validate_bucket(bucket, vae_scale=self._vae_scale)
             with self._samplers_lock:
-                if (bucket not in self._admitted_buckets
-                        and len(self._admitted_buckets)
-                        >= self.cfg.max_compiled_buckets):
-                    raise BucketLimitError(
-                        f"bucket {bucket} would exceed the resident compiled-"
-                        f"sampler budget ({self.cfg.max_compiled_buckets}); "
-                        "use an already-served parameter combination")
-                self._admitted_buckets.add(bucket)
+                bucket_added = bucket not in self._admitted_buckets
+                if bucket_added:
+                    if (len(self._admitted_buckets)
+                            >= self.cfg.max_compiled_buckets):
+                        raise BucketLimitError(
+                            f"bucket {bucket} would exceed the resident "
+                            f"compiled-sampler budget "
+                            f"({self.cfg.max_compiled_buckets}); use an "
+                            "already-served parameter combination")
+                    # dcr-hbm containment: a NOVEL bucket is a new resident
+                    # compiled program — consult the live-surface footprints
+                    # before admitting it, so one adversarial request can't
+                    # OOM a warm worker (typed 503, never a dead port)
+                    self._check_memory_budget(bucket)
+                    self._admitted_buckets.add(bucket)
             req = Request(prompt=prompt, seed=int(seed) & 0xFFFFFFFF,
                           bucket=bucket)
             trace_attrs: dict = {}
@@ -408,7 +424,22 @@ class GenerationService:
                                       request_id=req.id, seed=req.seed,
                                       bucket=str(tuple(bucket)), **trace_attrs)
             req.span = root
-            self.queue.submit(req)
+            try:
+                self.queue.submit(req)
+            except AdmissionError:
+                # a never-queued novel bucket must not consume a resident-
+                # program slot (and, under dcr-hbm, a phantom byte
+                # reservation) forever. Kept when a concurrently-queued
+                # request or a resident sampler still carries it — the rare
+                # concurrent-admit race then at worst over-counts by the
+                # one slot left registered (the supervisor makes the same
+                # trade).
+                if bucket_added:
+                    with self._samplers_lock:
+                        if (bucket not in self._samplers
+                                and not self.queue.has_bucket(bucket)):
+                            self._admitted_buckets.discard(bucket)
+                raise
         except AdmissionError as e:
             self.metrics.note_rejected(e)
             tracing.event("serve/rejected", error=type(e).__name__)
@@ -420,6 +451,41 @@ class GenerationService:
             lambda f: root.end(error=repr(f.exception()))
             if f.exception() is not None else root.end())
         return req
+
+    def _check_memory_budget(self, bucket: GenBucket) -> None:
+        """Reject a novel bucket whose estimated footprint exceeds remaining
+        device memory (caller holds ``_samplers_lock``). The estimate is the
+        largest non-argument footprint among this process's live
+        ``serve/batch_sampler`` programs (same model, same padded batch
+        shape — only baked-in statics differ); no live sibling or no
+        backend stats means no check, exactly the pre-dcr-hbm behavior.
+
+        Admitted-but-not-yet-compiled novel buckets RESERVE the estimate:
+        live stats only move once a program actually compiles, so without
+        the reservation a burst of distinct novel buckets would all pass
+        against the same unchanged reading and OOM together — the exact
+        hole this check exists to close."""
+        estimate = memwatch.estimate_surface_bytes("serve/batch_sampler")
+        if estimate is None:
+            return
+        remaining = memwatch.remaining_device_bytes()
+        if remaining is None:
+            return
+        pending = sum(1 for b in self._admitted_buckets
+                      if b not in self._samplers)
+        needed = estimate * (pending + 1)
+        if needed > remaining:
+            tracing.registry().counter(
+                "serve/rejected_memory_budget").inc()
+            R.log_event("memory_budget_rejected", bucket=str(tuple(bucket)),
+                        estimate_bytes=estimate, pending_compiles=pending,
+                        needed_bytes=needed, remaining_bytes=remaining)
+            raise MemoryBudgetError(
+                f"bucket {bucket} would compile a new resident program "
+                f"(~{estimate} bytes estimated from live surfaces; "
+                f"{pending} admitted compile(s) already pending) past "
+                f"remaining device memory ({remaining} bytes); use an "
+                "already-served parameter combination")
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -802,8 +868,12 @@ class GenerationService:
                                   sampler=bucket.sampler)
                      if calls < bucket.steps else contextlib.nullcontext())
         with profiling.capture():
+            # dcr-hbm: hbm_peak/hbm_delta attrs on the device step (no-op
+            # where the backend reports no memory stats)
             with tracing.span("serve/device_step", batch=n, request_ids=ids,
-                              trace_ids=traces, bucket=str(tuple(bucket))):
+                              trace_ids=traces,
+                              bucket=str(tuple(bucket))) as dsp, \
+                    memwatch.span_hbm(dsp):
                 with fast_span:
                     # np.asarray forces the transfer, so these spans close
                     # only when the device work is actually done — real
@@ -843,6 +913,12 @@ class GenerationService:
             simulate_hang(f"worker_hang@batch={batch_index}")
         if faults.fire("slow_step", batch=batch_index):
             time.sleep(float(os.environ.get("DCR_SLOW_STEP_S", "30")))
+        if faults.fire("oom", batch=batch_index):
+            # deterministic RESOURCE_EXHAUSTED through the real batch path:
+            # _process's OOM catch dumps the memory-enriched flight recorder
+            # and exits 85 — the typed death a fleet supervisor requeues
+            # around with zero drops
+            raise memwatch.InjectedOom(f"serve batch {batch_index}")
 
     def _process(self, batch: list[Request]) -> None:
         t0 = time.monotonic()
@@ -866,6 +942,19 @@ class GenerationService:
                 self._inject_batch_faults(batch_index)
                 images = self.execute(batch)
         except Exception as e:
+            if memwatch.is_oom_error(e):
+                # dcr-hbm fatal path: the device allocator failed — this
+                # process cannot promise any further batch, so die TYPED
+                # (exit 85) with a memory-enriched post-mortem instead of
+                # failing one batch and serving the next from a poisoned
+                # allocator. In a fleet the supervisor requeues the
+                # journaled in-flight requests onto survivors (zero drops);
+                # futures are deliberately left for the death to break.
+                with self._samplers_lock:
+                    buckets = [tuple(b) for b in self._samplers]
+                memwatch.oom_abort(
+                    f"serve batch {batch_index} bucket {batch[0].bucket}",
+                    e, buckets=buckets)
             R.log_event("serve_batch_failed", batch=len(batch),
                         bucket=str(batch[0].bucket), error=repr(e))
             for req in batch:

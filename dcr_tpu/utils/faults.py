@@ -42,6 +42,15 @@ coordinated-recovery tests. Supported kinds and their hook points:
   ``warmcache/*`` fault counter, and a clean recompile. This is how CI
   proves a poisoned executable cache can never crash a boot or load a
   wrong program. ``cache_corrupt@load=0`` poisons the first load.
+- ``oom`` — trainer loop (coord ``step``) and serve batch loop (coord
+  ``batch``): raises a RESOURCE_EXHAUSTED-shaped :class:`InjectedOom
+  <dcr_tpu.obs.memwatch.InjectedOom>` through the exact path a real XLA
+  allocator failure takes — the memory-enriched flight-recorder dump
+  (device stats + live-surface footprints + resident buckets) and the
+  typed ``EXIT_OOM`` (85) that a fleet supervisor treats like a crash
+  (journaled requests requeue, zero drops). ``oom@step=3`` kills a
+  trainer after its third micro-step; ``oom@batch=0&rank=1`` kills fleet
+  worker 1 on its first batch.
 - ``latent_cache_corrupt`` — latent-cache shard load (data/latent_cache.py),
   coord ``load`` (per-reader shard read index): damages the just-read shard
   bytes in memory so the sha verification fails exactly like real bit rot —

@@ -160,13 +160,14 @@ def capture():
 
 def flops_of_jitted(jitted_fn, *args, **kwargs) -> float:
     """Per-device FLOPs of an already-jitted function from XLA's cost analysis
-    (post-GSPMD-partitioning, so this is the per-chip share). 0 if unavailable."""
+    (post-GSPMD-partitioning, so this is the per-chip share). 0 if
+    unavailable. Extraction (list-vs-dict analysis shapes) lives in
+    obs/memwatch.flops_of_compiled — the ONE implementation bench.py and the
+    StepTimer MFU numbers share."""
+    from dcr_tpu.obs.memwatch import flops_of_compiled
+
     try:
-        compiled = jitted_fn.lower(*args, **kwargs).compile()
-        analysis = compiled.cost_analysis()
-        if isinstance(analysis, list):  # older jax returns per-device list
-            analysis = analysis[0]
-        return float(analysis.get("flops", 0.0))
+        return flops_of_compiled(jitted_fn.lower(*args, **kwargs).compile())
     except Exception:
         return 0.0
 

@@ -1,0 +1,621 @@
+"""dcr-hbm tests: memory observability — static accounting, live telemetry,
+OOM forensics, and the manifest memory budget.
+
+Fast tier: pure-logic + tiny-compile units — memory_block extraction from a
+real compiled program, the shared cost_analysis FLOPs helper, the
+DCR_MEMWATCH_FAKE-driven stats/gauge/span paths (the CPU backend reports no
+memory_stats, which is itself asserted), the ``oom`` fault kind, the
+enriched oom_abort dump, the best-effort memory snapshot on EVERY
+flight-recorder dump, the serve memory-budget admission check, and the
+compile-manifest memory-budget diff (injected regression -> readable
+failure; tolerance; shrinkage and version-skew never fail).
+
+Slow tier (CI ``memory-budget`` job): a real trainer CLI subprocess with an
+injected ``oom@step=N`` exits 85 leaving a memory-enriched flight-recorder
+dump; a 2-worker fleet with ``oom@batch=0&rank=0`` requeues the dead
+worker's in-flight requests with zero drops and responses bit-identical to
+an uninjected fleet, with the typed dump present in the fleet dir.
+"""
+
+import json
+
+import pytest
+
+from dcr_tpu.core import tracing
+from dcr_tpu.obs import memwatch
+from dcr_tpu.utils import faults
+
+FAKE = json.dumps({"bytes_in_use": 1000, "peak_bytes_in_use": 1500,
+                   "bytes_limit": 10_000})
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv(memwatch.FAKE_ENV, raising=False)
+    tracing.reset_for_tests()
+    memwatch.reset_for_tests()
+    faults.clear()
+    yield
+    tracing.reset_for_tests()
+    memwatch.reset_for_tests()
+    faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# static accounting
+# ---------------------------------------------------------------------------
+
+def _toy_compiled():
+    import jax
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x @ x)
+    return fn.lower(jax.ShapeDtypeStruct((64, 64), jnp.float32)).compile()
+
+
+@pytest.mark.fast
+def test_memory_block_of_real_compiled_program(cpu_devices):
+    mem = memwatch.memory_block(_toy_compiled())
+    assert mem is not None
+    # 64x64 float32 in and out: the byte accounting is exact, not heuristic
+    assert mem["argument_bytes"] == 64 * 64 * 4
+    assert mem["output_bytes"] == 64 * 64 * 4
+    assert mem["total_bytes"] >= mem["argument_bytes"] + mem["output_bytes"]
+    assert mem["flops"] > 0  # cost_analysis rides along
+
+
+@pytest.mark.fast
+def test_memory_block_degrades_to_none():
+    class NoAnalysis:
+        def memory_analysis(self):
+            return None
+
+    class Broken:
+        def memory_analysis(self):
+            raise RuntimeError("backend says no")
+
+    assert memwatch.memory_block(NoAnalysis()) is None
+    assert memwatch.memory_block(Broken()) is None
+
+
+@pytest.mark.fast
+def test_flops_helper_handles_every_analysis_shape():
+    assert memwatch.flops_of_analysis({"flops": 12.0}) == 12.0
+    assert memwatch.flops_of_analysis([{"flops": 7.0}, {"flops": 9.0}]) == 7.0
+    assert memwatch.flops_of_analysis(None) == 0.0
+    assert memwatch.flops_of_analysis([]) == 0.0
+    assert memwatch.flops_of_analysis({}) == 0.0
+
+    class NoCost:
+        def cost_analysis(self):
+            raise RuntimeError("nope")
+
+    assert memwatch.flops_of_compiled(NoCost()) == 0.0
+
+
+@pytest.mark.fast
+def test_profiling_flops_routes_through_shared_helper(cpu_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.utils.profiling import flops_of_jitted
+
+    fn = jax.jit(lambda x: x @ x)
+    aval = jax.ShapeDtypeStruct((16, 16), jnp.float32)
+    flops = flops_of_jitted(fn, aval)
+    assert flops == memwatch.flops_of_compiled(fn.lower(aval).compile())
+    assert flops > 0
+
+
+# ---------------------------------------------------------------------------
+# live telemetry: stats, gauges, sampler, span attrs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_cpu_backend_reports_no_stats(cpu_devices):
+    # the env fact the graceful no-ops exist for; if a future jaxlib grows
+    # CPU memory_stats this test tells us the no-op paths went live
+    assert memwatch.device_memory_stats() is None
+    assert memwatch.peak_bytes() is None
+    assert memwatch.remaining_device_bytes() is None
+    assert memwatch.start_sampler() is False
+
+
+@pytest.mark.fast
+def test_fake_env_stats_and_gauges(monkeypatch):
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    stats = memwatch.device_memory_stats()
+    assert stats == {"bytes_in_use": 1000, "peak_bytes": 1500,
+                     "bytes_limit": 10_000}
+    assert memwatch.peak_bytes() == 1500
+    assert memwatch.remaining_device_bytes() == 9000
+    assert memwatch.update_memory_gauges() == stats
+    text = tracing.registry().prometheus_text()
+    assert "dcr_device_mem_in_use_bytes 1000" in text
+    assert "dcr_device_mem_peak_bytes 1500" in text
+    assert "dcr_device_mem_limit_bytes 10000" in text
+
+
+@pytest.mark.fast
+def test_fake_env_bad_json_is_loud_not_fatal(monkeypatch):
+    monkeypatch.setenv(memwatch.FAKE_ENV, "{not json")
+    assert memwatch.device_memory_stats() is None
+
+
+@pytest.mark.fast
+def test_sampler_runs_on_stats_backends(monkeypatch):
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    sampler = memwatch.MemorySampler(period_s=0.1)
+    try:
+        assert sampler.start() is True
+        assert sampler.active
+        assert tracing.registry().gauge("device_mem/in_use_bytes").value \
+            == 1000
+    finally:
+        sampler.stop()
+
+
+@pytest.mark.fast
+def test_span_hbm_attrs_present_with_stats_absent_without(monkeypatch,
+                                                          cpu_devices):
+    with tracing.span("serve/device_step") as sp, memwatch.span_hbm(sp):
+        pass
+    assert "hbm_peak" not in tracing.flight_records()[-1]["args"]
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    with tracing.span("serve/device_step") as sp, memwatch.span_hbm(sp):
+        pass
+    args = tracing.flight_records()[-1]["args"]
+    assert args["hbm_peak"] == 1500 and args["hbm_delta"] == 0
+
+
+# ---------------------------------------------------------------------------
+# live-surface registry + aot_compile capture
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_live_surface_registry_and_estimates():
+    memwatch.note_surface("serve/batch_sampler", "k1",
+                          {"temp_bytes": 100, "output_bytes": 50,
+                           "generated_code_bytes": 10, "argument_bytes": 999})
+    memwatch.note_surface("serve/batch_sampler", "k2", {"temp_bytes": 400})
+    memwatch.note_surface("train/step", "k3", {"temp_bytes": 1000})
+    # estimate = max non-argument footprint within the family (arguments are
+    # the shared params, not a per-program cost)
+    assert memwatch.estimate_surface_bytes("serve/batch_sampler") == 400
+    assert memwatch.estimate_surface_bytes("eval/") is None
+    assert memwatch.resident_program_bytes() == 160 + 400 + 1000
+
+
+@pytest.mark.fast
+def test_aot_compile_captures_surface_memory(cpu_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import warmcache
+
+    res = warmcache.aot_compile(
+        "toy/surface", jax.jit(lambda x: x @ x),
+        (jax.ShapeDtypeStruct((32, 32), jnp.float32),))
+    assert res.memory is not None
+    assert res.memory["argument_bytes"] == 32 * 32 * 4
+    foot = memwatch.live_footprints()
+    assert any(k.startswith("toy/surface@") for k in foot)
+    events = [r for r in tracing.flight_records()
+              if r["name"] == "memwatch/surface_memory"]
+    assert events and events[-1]["args"]["surface"] == "toy/surface"
+    assert events[-1]["args"]["argument_bytes"] == 32 * 32 * 4
+
+
+# ---------------------------------------------------------------------------
+# OOM detection, fault kind, enriched dump
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_is_oom_error_classification():
+    assert memwatch.is_oom_error(memwatch.InjectedOom("here"))
+    assert memwatch.is_oom_error(
+        RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                     "13529146368 bytes"))
+    assert memwatch.is_oom_error(
+        RuntimeError("XlaRuntimeError: Allocator ran out of memory: "
+                     "OOM when allocating tensor"))
+    assert memwatch.is_oom_error(MemoryError())
+    assert not memwatch.is_oom_error(ValueError("shape mismatch"))
+    assert not memwatch.is_oom_error(FloatingPointError("nan loss"))
+
+
+@pytest.mark.fast
+def test_oom_fault_kind_parses_and_fires():
+    faults.install("oom@step=2")
+    assert not faults.fire("oom", step=1)
+    assert faults.fire("oom", step=2)
+    assert not faults.fire("oom", step=2)   # single-shot by default
+    faults.install("oom@batch=1")
+    assert not faults.fire("oom", batch=0)
+    assert faults.fire("oom", batch=1)
+
+
+@pytest.mark.fast
+def test_oom_abort_dump_is_enriched_and_exits_85(tmp_path, monkeypatch):
+    from dcr_tpu.core.coordination import EXIT_OOM
+
+    assert EXIT_OOM == 85
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    tracing.configure(tmp_path, rank=0)
+    memwatch.note_surface("serve/batch_sampler", "k1",
+                          {"temp_bytes": 123, "total_bytes": 456})
+    codes: list = []
+    memwatch.oom_abort("serve batch 0", memwatch.InjectedOom("serve batch 0"),
+                       buckets=[(16, 2, 7.5, "ddim", 0.0)],
+                       exit_fn=codes.append)
+    assert codes == [85]
+    doc = json.loads((tmp_path / "flightrec_0.json").read_text())
+    assert doc["reason"].startswith("oom:")
+    # OOM-specific fields under "oom"; the memory snapshot itself rides the
+    # top-level "memory" key every dump carries (computed once, not twice)
+    assert doc["oom"]["compiled_buckets"] == [[16, 2, 7.5, "ddim", 0.0]]
+    assert doc["oom"]["where"] == "serve batch 0"
+    assert doc["memory"]["device_memory_stats"]["bytes_in_use"] == 1000
+    assert "serve/batch_sampler@k1" in doc["memory"]["live_surfaces"]
+    # the registry snapshot and span ring ride along as on every fatal path
+    assert "registry" in doc and "records" in doc
+
+
+@pytest.mark.fast
+def test_every_flight_rec_dump_carries_memory_snapshot(tmp_path, monkeypatch):
+    # the satellite: NaN abort / hang / preempt / excepthook dumps (all go
+    # through dump_flight_recorder) now answer "how full was the device"
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    tracing.configure(tmp_path, rank=0)
+    memwatch.note_surface("train/step", "k", {"temp_bytes": 7})
+    path = tracing.dump_flight_recorder("nan_abort: step 3 loss nan")
+    doc = json.loads(path.read_text())
+    assert doc["memory"]["device_memory_stats"]["peak_bytes"] == 1500
+    assert "train/step@k" in doc["memory"]["live_surfaces"]
+
+
+# ---------------------------------------------------------------------------
+# serve containment: memory-budget admission
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_memory_budget_admission_check(monkeypatch):
+    import types
+
+    from dcr_tpu.serve.queue import GenBucket, MemoryBudgetError
+    from dcr_tpu.serve.worker import GenerationService
+
+    stub = types.SimpleNamespace(_admitted_buckets=set(), _samplers={})
+    bucket = GenBucket(16, 2, 7.5, "ddim", 0.0)
+    # no live sibling surface -> no check (first program is readiness's)
+    GenerationService._check_memory_budget(stub, bucket)
+    memwatch.note_surface("serve/batch_sampler", "k1",
+                          {"temp_bytes": 5000, "output_bytes": 0,
+                           "generated_code_bytes": 0})
+    # no backend stats (CPU) -> no check
+    GenerationService._check_memory_budget(stub, bucket)
+    # estimate 5000 > remaining 9000? no -> admits
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    GenerationService._check_memory_budget(stub, bucket)
+    # an admitted-but-uncompiled novel bucket RESERVES its estimate: the
+    # second novel bucket needs 2x5000 > 9000 even though live stats have
+    # not moved yet (the burst-of-novel-buckets hole)
+    other = GenBucket(16, 4, 7.5, "ddim", 0.0)
+    stub._admitted_buckets = {other}
+    with pytest.raises(MemoryBudgetError):
+        GenerationService._check_memory_budget(stub, bucket)
+    # once that bucket's program is resident the reservation is released
+    # (live stats are then the truth)
+    stub._samplers = {other: object()}
+    GenerationService._check_memory_budget(stub, bucket)
+    # nearly-full device: remaining 100 < estimate 5000 -> typed rejection
+    monkeypatch.setenv(memwatch.FAKE_ENV, json.dumps(
+        {"bytes_in_use": 9900, "peak_bytes_in_use": 9900,
+         "bytes_limit": 10_000}))
+    with pytest.raises(MemoryBudgetError):
+        GenerationService._check_memory_budget(stub, bucket)
+    assert tracing.registry().counter(
+        "serve/rejected_memory_budget").value == 2
+
+
+@pytest.mark.fast
+def test_queue_has_bucket_guards_admission_rollback():
+    # the worker's rejected-admission rollback (a never-queued novel bucket
+    # must not hold a resident-program slot / byte reservation forever)
+    # keeps a bucket that a concurrently-queued request still references
+    from dcr_tpu.serve.queue import GenBucket, Request, RequestQueue
+
+    q = RequestQueue(4)
+    b = GenBucket(16, 2, 7.5, "ddim", 0.0)
+    other = GenBucket(16, 4, 7.5, "ddim", 0.0)
+    assert not q.has_bucket(b)
+    q.submit(Request(prompt="p", seed=0, bucket=b))
+    assert q.has_bucket(b) and not q.has_bucket(other)
+
+
+@pytest.mark.fast
+def test_memory_budget_maps_to_typed_503():
+    from dcr_tpu.serve.queue import MemoryBudgetError
+    from dcr_tpu.serve.server import admission_response
+
+    code, payload, _ = admission_response(MemoryBudgetError("too big"))
+    assert code == 503 and payload["error"] == "memory_budget"
+
+
+@pytest.mark.fast
+def test_supervisor_names_oom_exits():
+    from dcr_tpu.serve.supervisor import FleetSupervisor
+
+    assert "EXIT_OOM" in FleetSupervisor._rc_reason(85)
+    assert FleetSupervisor._rc_reason(1) == "process exited rc=1"
+
+
+# ---------------------------------------------------------------------------
+# manifest memory budget
+# ---------------------------------------------------------------------------
+
+def _entry_with_memory(temp=1_000_000, arg=2_000_000, flops=5e9) -> dict:
+    return {
+        "surface": "toy/surface", "variant": "default", "static_config": {},
+        "donate_argnums": [], "donated_inputs": 0,
+        "in_avals": {"leaves": 1, "digest": "d", "detail": []},
+        "out_avals": {"leaves": 1, "digest": "d", "detail": []},
+        "lowered_sha256": "abc",
+        "memory": {"argument_bytes": arg, "output_bytes": 1024,
+                   "temp_bytes": temp, "generated_code_bytes": 0,
+                   "total_bytes": arg + 1024 + temp, "flops": flops},
+    }
+
+
+def _wrap(entry) -> dict:
+    import jax
+
+    return {"version": 1, "jax_version": jax.__version__,
+            "entries": {"toy/surface@default": entry}}
+
+
+@pytest.mark.fast
+def test_manifest_memory_regression_is_readable_failure():
+    from tools.check.manifest import diff_manifests
+
+    old = _wrap(_entry_with_memory(temp=1_000_000))
+    new = _wrap(_entry_with_memory(temp=2_000_000))
+    diff = "\n".join(diff_manifests(old, new))
+    assert "memory.temp_bytes" in diff
+    assert "budget" in diff and "OOM" in diff
+    assert "toy/surface@default" in diff
+    # total_bytes moved with it
+    assert "memory.total_bytes" in diff
+
+
+@pytest.mark.fast
+def test_manifest_memory_tolerance_and_shrinkage():
+    from tools.check.manifest import diff_manifests
+
+    old = _wrap(_entry_with_memory(temp=1_000_000))
+    within = _wrap(_entry_with_memory(temp=1_050_000))   # +5% < 10% budget
+    assert diff_manifests(old, within) == []
+    over = _wrap(_entry_with_memory(temp=1_200_000))     # +20% > 10%
+    assert diff_manifests(old, over)
+    # a looser configured tolerance admits the same growth
+    assert diff_manifests(old, over, memory_tolerance=0.5) == []
+    # shrinkage never fails (a smaller footprint needs no sign-off)
+    smaller = _wrap(_entry_with_memory(temp=100_000))
+    assert diff_manifests(old, smaller) == []
+
+
+@pytest.mark.fast
+def test_manifest_memory_skips_on_version_skew_and_absent_fields():
+    from tools.check.manifest import diff_manifests
+
+    old = _wrap(_entry_with_memory(temp=1_000_000))
+    old["jax_version"] = "0.0.0-other"
+    new = _wrap(_entry_with_memory(temp=9_000_000))
+    # different toolchain: memory budgets (like HLO digests) not compared
+    assert diff_manifests(old, new) == []
+    # pre-dcr-hbm manifest (no memory block): present-field degrade
+    legacy = _wrap(_entry_with_memory())
+    del legacy["entries"]["toy/surface@default"]["memory"]
+    assert diff_manifests(legacy, _wrap(_entry_with_memory())) == []
+
+
+@pytest.mark.fast
+def test_manifest_flops_regression_fails_budget():
+    from tools.check.manifest import diff_manifests
+
+    old = _wrap(_entry_with_memory(flops=5e9))
+    new = _wrap(_entry_with_memory(flops=7e9))
+    diff = "\n".join(diff_manifests(old, new))
+    assert "memory.flops" in diff
+
+
+@pytest.mark.fast
+def test_checked_in_manifest_carries_memory_blocks():
+    import pathlib
+
+    data = json.loads((pathlib.Path(__file__).parent.parent
+                       / "compile_manifest.json").read_text())
+    for key, entry in data["entries"].items():
+        mem = entry.get("memory")
+        assert mem, f"{key} has no banked memory block"
+        assert mem["argument_bytes"] > 0, key
+        assert "total_bytes" in mem, key
+
+
+@pytest.mark.fast
+def test_fingerprint_banks_memory_block(cpu_devices):
+    import jax
+    import jax.numpy as jnp
+
+    from tools.check.manifest import fingerprint
+
+    aval = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    entry = fingerprint("toy/surface@default", jax.jit(lambda x: x + 1),
+                        (aval,), static_config={}, surface="toy/surface")
+    assert entry["memory"]["argument_bytes"] == 8 * 8 * 4
+    assert entry["memory"]["output_bytes"] == 8 * 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# trace_report "Memory" section
+# ---------------------------------------------------------------------------
+
+def _rec(name, ph="X", ts=0, dur=10, args=None, rid=1):
+    rec = {"ph": ph, "name": name, "id": rid, "ts": ts, "pid": 0, "tid": 1,
+           "tname": "t", "args": args or {}, "parent": None,
+           "_proc": 0, "_plabel": "trace.jsonl"}
+    if ph == "X":
+        rec["dur"] = dur
+    return rec
+
+
+@pytest.mark.fast
+def test_trace_report_memory_section_arithmetic():
+    from tools.trace_report import memory_summary
+
+    records = [
+        _rec("train/step", ts=10, args={"hbm_peak": 100, "hbm_delta": 5}),
+        _rec("train/step", ts=20, args={"hbm_peak": 300, "hbm_delta": -2}),
+        _rec("serve/device_step", ts=30,
+             args={"hbm_peak": 200, "hbm_delta": 7}),
+        _rec("memwatch/surface_memory", ph="i", ts=5,
+             args={"surface": "serve/batch_sampler", "key": "abcdef012345",
+                   "temp_bytes": 900, "argument_bytes": 10,
+                   "output_bytes": 20, "total_bytes": 930}),
+        _rec("memwatch/surface_memory", ph="i", ts=6,
+             args={"surface": "train/step", "key": "ffff",
+                   "temp_bytes": 100, "total_bytes": 100}),
+        _rec("train/data_wait", ts=40),   # no hbm attrs: not sampled
+    ]
+    mem = memory_summary(records)
+    assert mem["sampled_spans"] == 3
+    assert mem["peak_bytes"] == 300
+    steps = mem["resident_delta_by_stage"]["train/step"]
+    assert steps == {"count": 2, "delta_bytes": 3, "peak_bytes": 300}
+    assert mem["resident_delta_by_stage"]["serve/device_step"][
+        "delta_bytes"] == 7
+    assert [t["peak_bytes"] for t in mem["peak_timeline"]] == [100, 300, 200]
+    top = mem["top_surfaces_by_temp_bytes"]
+    assert top[0]["surface"].startswith("serve/batch_sampler@abcdef01")
+    assert top[0]["temp_bytes"] == 900 and top[1]["temp_bytes"] == 100
+
+
+@pytest.mark.fast
+def test_trace_report_memory_section_absent_without_data_and_renders():
+    from pathlib import Path
+
+    from tools.trace_report import memory_summary, render_text, summarize
+
+    assert memory_summary([_rec("train/step")]) is None
+    summary = summarize([
+        _rec("train/step", args={"hbm_peak": 100, "hbm_delta": 5})], {})
+    text = render_text(summary, Path("x"))
+    assert "memory: peak 100 bytes" in text
+    # a memory-less summary renders with no memory section
+    no_mem = summarize([_rec("train/step")], {})
+    assert no_mem["memory"] is None
+    assert "memory: peak" not in render_text(no_mem, Path("x"))
+
+
+@pytest.mark.fast
+def test_trace_schema_accepts_surface_memory_events(tmp_path):
+    # a real emitted memwatch/surface_memory event validates against the
+    # checked-in schema (the observability job gates on this)
+    from tools import trace_report
+
+    tracing.configure(tmp_path, rank=0)
+    tracing.event("memwatch/surface_memory", surface="toy/s", key="k",
+                  attrs={"temp_bytes": 1})
+    schema = trace_report.load_schema()
+    records, errors = trace_report.load_trace(tmp_path, schema)
+    assert errors == [] and len(records) == 1
+
+
+# ---------------------------------------------------------------------------
+# slow e2e: injected OOM through the real CLIs
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_trainer_oom_exits_85_with_enriched_dump(tmp_path, monkeypatch,
+                                                 cpu_devices):
+    """oom@step=3 in a real `dcr_tpu.cli.train` subprocess: typed exit 85
+    (not a stack-trace exit 1), and the flight-recorder dump carries the
+    oom section with the (faked) device stats and live-surface
+    footprints."""
+    import numpy as np
+    from PIL import Image
+
+    from dcr_tpu.core.config import (DataConfig, ModelConfig, OptimConfig,
+                                     TrainConfig)
+    from tests.test_fault_injection import _run_cli
+
+    rng = np.random.default_rng(0)
+    for cls in ["c0", "c1"]:
+        d = tmp_path / "data" / cls
+        d.mkdir(parents=True)
+        for i in range(8):
+            Image.fromarray(
+                rng.integers(0, 255, (20, 20, 3), np.uint8)).save(
+                d / f"{i}.png")
+    cfg = TrainConfig(
+        output_dir=str(tmp_path / "run"), seed=0, train_batch_size=2,
+        max_train_steps=6, num_train_epochs=20, mixed_precision="no",
+        save_steps=1000, modelsavesteps=1000, log_every=1,
+        model=ModelConfig.tiny(),
+        data=DataConfig(train_data_dir=str(tmp_path / "data"), resolution=16,
+                        class_prompt="nolevel", num_workers=2, seed=0),
+        optim=OptimConfig(learning_rate=1e-4, lr_scheduler="constant",
+                          lr_warmup_steps=0))
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    proc, out = _run_cli(cfg, tmp_path / "cfg.json", dcr_faults="oom@step=3")
+    assert proc.returncode == 85, out[-4000:]
+    dump = tmp_path / "run" / "flightrec_0.json"
+    assert dump.exists(), out[-4000:]
+    doc = json.loads(dump.read_text())
+    assert doc["reason"].startswith("oom:"), doc["reason"]
+    assert doc["memory"]["device_memory_stats"]["bytes_in_use"] == 1000
+    # the injected fault is visible in the record (not a silent real OOM)
+    assert "injected" in doc["oom"]["error"]
+    assert doc["oom"]["where"].startswith("train step")
+
+
+@pytest.mark.slow
+def test_fleet_oom_requeues_zero_drops_bit_identical(tmp_path, monkeypatch,
+                                                     cpu_devices):
+    """Acceptance: 2 workers, worker 0 killed by an injected oom on every
+    batch it touches (exit 85) — its journaled in-flight requests requeue
+    onto worker 1, every accepted request completes bit-identical to an
+    uninjected fleet with zero drops, and the worker left a memory-enriched
+    oom dump in the fleet dir."""
+    from tests.test_fleet import _run_fleet
+    from tests.test_serve import _export_tiny_ckpt
+
+    monkeypatch.setenv(memwatch.FAKE_ENV, FAKE)
+    ckpt = _export_tiny_ckpt(tmp_path)
+
+    clean, clean_counts, _ = _run_fleet(tmp_path, ckpt, "clean")
+    assert clean_counts["dropped"] == 0 and clean_counts["failed"] == 0
+
+    chaos, chaos_counts, status = _run_fleet(
+        tmp_path, ckpt, "oom", faults="oom@batch=0&rank=0")
+    assert chaos_counts["dropped"] == 0, chaos_counts
+    assert chaos_counts["failed"] == 0, chaos_counts
+    assert chaos_counts["accepted"] == 8 and chaos_counts["acked"] == 8
+    assert chaos_counts["requeued_total"] >= 1, chaos_counts
+    assert status["fleet"].get("workers_lost", 0) >= 1, status["fleet"]
+    # bit-identical: which worker (or incarnation) rendered is invisible
+    assert set(chaos) == set(clean)
+    for job in clean:
+        assert chaos[job] == clean[job], f"response diverged for {job}"
+    # the typed post-mortem: worker 0's dump names oom and carries the
+    # memory snapshot (fake stats propagate into the worker env; fleet
+    # workers trace under <fleet.dir>/worker_<i>/)
+    dump = tmp_path / "fleet_oom" / "worker_0" / "flightrec_w0_0.json"
+    assert dump.exists()
+    doc = json.loads(dump.read_text())
+    assert doc["reason"].startswith("oom:"), doc["reason"]
+    assert doc["memory"]["device_memory_stats"]["bytes_in_use"] == 1000
+    assert doc["oom"]["compiled_buckets"], "resident bucket set missing"
+    # the worker's resident serve programs are accounted in the snapshot
+    assert any(k.startswith("serve/")
+               for k in doc["memory"]["live_surfaces"])

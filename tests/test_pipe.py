@@ -516,11 +516,12 @@ def test_bench_pipe_schema():
     doc = {
         "cores": 1, "steps": 10, "min_speedup": 1.25, "batch_sizes": [4],
         "legs": {"bs4": {
-            "fused": {"steps_per_sec": 5.0, "step_ms": 200.0},
+            "fused": {"steps_per_sec": 5.0, "step_ms": 200.0,
+                      "hbm_peak_bytes": None},
             "pipelined": {"steps_per_sec": 5.5, "step_ms": 182.0,
-                          "speedup": 1.1},
+                          "speedup": 1.1, "hbm_peak_bytes": 123456},
             "latent_cache": {"steps_per_sec": 7.0, "step_ms": 143.0,
-                             "speedup": 1.4},
+                             "speedup": 1.4, "hbm_peak_bytes": None},
         }},
         "gate": {"batch_size": 4, "speedup": 1.4, "mode": "latent_cache",
                  "passed": True},
@@ -530,6 +531,14 @@ def test_bench_pipe_schema():
     del bad["gate"]["passed"]
     bad["legs"]["bs4"]["pipelined"].pop("speedup")
     assert len(bp.validate_result(bad)) == 2
+    # dcr-hbm: hbm_peak_bytes must be present (null on stats-less backends)
+    # and integral where present
+    missing = json.loads(json.dumps(doc))
+    missing["legs"]["bs4"]["fused"].pop("hbm_peak_bytes")
+    wrong = json.loads(json.dumps(doc))
+    wrong["legs"]["bs4"]["fused"]["hbm_peak_bytes"] = "big"
+    assert any("hbm_peak_bytes" in p for p in bp.validate_result(missing))
+    assert any("hbm_peak_bytes" in p for p in bp.validate_result(wrong))
 
 
 def test_banked_bench_pipe_artifact_is_valid_and_gated():

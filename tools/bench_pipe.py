@@ -136,6 +136,7 @@ def _flops(fn, *args) -> float:
 
 
 def _leg_result(steps: int, dt: float, flops: float) -> dict:
+    from dcr_tpu.obs.memwatch import peak_bytes
     from dcr_tpu.utils.profiling import chip_peak_tflops
 
     peak = chip_peak_tflops() * 1e12
@@ -144,7 +145,13 @@ def _leg_result(steps: int, dt: float, flops: float) -> dict:
     return {"steps_per_sec": round(steps / dt, 3),
             "step_ms": round(per_step * 1e3, 2),
             "gflops_per_step": round(flops / 1e9, 2) if flops else None,
-            "mfu": round(mfu, 5) if mfu else None}
+            "mfu": round(mfu, 5) if mfu else None,
+            # dcr-hbm: process high-water mark AS OF leg end (null on
+            # backends without memory_stats — XLA:CPU, this CI rig).
+            # Monotonic across the legs sharing this process: read the
+            # step between consecutive legs, not each value as an
+            # independent per-leg peak (XLA has no peak reset).
+            "hbm_peak_bytes": peak_bytes()}
 
 
 def run_fused(rig: _Rig, steps: int, losses: list | None = None) -> dict:
@@ -341,6 +348,16 @@ def validate_result(doc: dict) -> list[str]:
             row = need(group, leg, dict, f"$.legs.bs{bs}") or {}
             need(row, "steps_per_sec", (int, float), f"$.legs.bs{bs}.{leg}")
             need(row, "step_ms", (int, float), f"$.legs.bs{bs}.{leg}")
+            # dcr-hbm: present on every leg, null where the backend has no
+            # memory stats (int bytes where it does)
+            if "hbm_peak_bytes" not in row:
+                problems.append(f"$.legs.bs{bs}.{leg}.hbm_peak_bytes: "
+                                "missing")
+            elif not isinstance(row["hbm_peak_bytes"], (int, type(None))) \
+                    or isinstance(row["hbm_peak_bytes"], bool):
+                problems.append(
+                    f"$.legs.bs{bs}.{leg}.hbm_peak_bytes: "
+                    f"{type(row['hbm_peak_bytes']).__name__}")
             if leg != "fused":
                 need(row, "speedup", (int, float), f"$.legs.bs{bs}.{leg}")
     gate = need(doc, "gate", dict, "$") or {}

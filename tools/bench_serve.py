@@ -117,6 +117,17 @@ def _prompts(n: int) -> list[str]:
     return [uniq[i % len(uniq)] for i in range(n)]
 
 
+def _peak_bytes():
+    """dcr-hbm: peak device bytes so far (None on stats-less backends) —
+    the HBM number every banked leg carries. Monotonic per process (no
+    XLA peak reset): legs sharing one process bank the high-water mark as
+    of THEIR end, so compare consecutive legs' steps, not absolute
+    values."""
+    from dcr_tpu.obs.memwatch import peak_bytes
+
+    return peak_bytes()
+
+
 def main() -> None:
     n_requests = int(os.environ.get("BENCH_SERVE_REQUESTS", "32"))
     max_batch = int(os.environ.get("BENCH_SERVE_BATCH", "8"))
@@ -156,6 +167,9 @@ def main() -> None:
         "total_s": round(seq_s, 3),
         "requests_per_s": round(n_requests / seq_s, 3),
         "cache": seq.cache.stats(),
+        # dcr-hbm: peak device bytes after the leg (null without backend
+        # memory stats — XLA:CPU)
+        "hbm_peak_bytes": _peak_bytes(),
     }
     print("sequential:", json.dumps(result["sequential"]), flush=True)
 
@@ -178,6 +192,7 @@ def main() -> None:
         "batch_occupancy_max": snap["batch_occupancy_max"],
         "latency_ms": snap["latency_ms"],
         "cache": bat.cache.stats(),
+        "hbm_peak_bytes": _peak_bytes(),
     }
     result["speedup"] = round(seq_s / bat_s, 3)
     print("batched:", json.dumps(result["batched"]), flush=True)
@@ -696,6 +711,7 @@ def risk_main() -> None:
             "total_s": round(off_s, 3),
             "requests_per_s": round(n_requests / off_s, 3),
             "latency_ms": snap_off["latency_ms"],
+            "hbm_peak_bytes": _peak_bytes(),
         }
         print("scoring off:", json.dumps(result["scoring_off"]), flush=True)
 
@@ -713,6 +729,7 @@ def risk_main() -> None:
             "scoring_s": round(score_s, 3),
             "latency_ms": snap_on["latency_ms"],
             "risk": scored,
+            "hbm_peak_bytes": _peak_bytes(),
         }
         print("scoring on:", json.dumps(result["scoring_on"]), flush=True)
 
@@ -801,7 +818,8 @@ def fast_main() -> None:
         return {"total_s": round(elapsed, 3),
                 "reps": reps,
                 "requests_per_s": round(n_requests / elapsed, 3),
-                "latency_ms": snap["latency_ms"]}
+                "latency_ms": snap["latency_ms"],
+                "hbm_peak_bytes": _peak_bytes()}
 
     result["dense"] = leg()
     print("dense:", json.dumps(result["dense"]), flush=True)

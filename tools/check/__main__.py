@@ -49,6 +49,11 @@ def _build_parser() -> argparse.ArgumentParser:
     p.add_argument("--manifest", type=Path, default=None,
                    help="manifest path override (default: "
                         "[tool.dcr-check].manifest)")
+    p.add_argument("--memory-tolerance", type=float, default=None,
+                   metavar="FRAC",
+                   help="dcr-hbm: relative headroom over each entry's banked "
+                        "memory block before the budget diff fails (default: "
+                        "[tool.dcr-check].memory-tolerance, 0.10)")
     p.add_argument("--config", type=Path, default=None,
                    help="pyproject.toml to read [tool.dcr-check] from")
     return p
@@ -79,7 +84,8 @@ def _print_layer1(report: CheckReport, fmt: str) -> None:
           "pragma]")
 
 
-def _run_manifest(cfg, manifest_path: Path, update: bool, fmt: str) -> int:
+def _run_manifest(cfg, manifest_path: Path, update: bool, fmt: str,
+                  memory_tolerance: Optional[float] = None) -> int:
     # import jax only here, after env defaults: the static layers must work
     # on machines with no jax at all
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -98,7 +104,9 @@ def _run_manifest(cfg, manifest_path: Path, update: bool, fmt: str) -> int:
               f"{manifest_path}")
         return 0
     old = load_manifest(manifest_path)
-    diff = diff_manifests(old, new)
+    tol = (memory_tolerance if memory_tolerance is not None
+           else cfg.memory_tolerance)
+    diff = diff_manifests(old, new, memory_tolerance=tol)
     if not diff:
         if fmt == "human":
             print(f"dcr-check: compile manifest up to date "
@@ -144,7 +152,8 @@ def main(argv: Optional[list[str]] = None) -> int:
             rc = 1 if report.findings else 0
         if not args.no_manifest:
             mrc = _run_manifest(cfg, manifest_path, args.update_manifest,
-                                args.format)
+                                args.format,
+                                memory_tolerance=args.memory_tolerance)
             rc = max(rc, mrc)
         return rc
     except LintError as e:

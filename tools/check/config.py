@@ -42,6 +42,10 @@ class CheckConfig:
     entry_modules: tuple[str, ...] = DEFAULT_ENTRY_MODULES
     hot_paths: tuple[str, ...] = DEFAULT_HOT_PATHS
     manifest: str = "compile_manifest.json"
+    # dcr-hbm: relative headroom over each manifest entry's banked memory
+    # block before the budget diff fails (``memory-tolerance`` in
+    # [tool.dcr-check]; --memory-tolerance overrides per run)
+    memory_tolerance: float = 0.10
     exclude: tuple[str, ...] = ("__pycache__",)
     root: Path = field(default_factory=Path)
 
@@ -73,6 +77,7 @@ def load_check_config(pyproject: Optional[Path] = None,
                                         DEFAULT_ENTRY_MODULES)),
         hot_paths=tuple(section.get("hot-paths", DEFAULT_HOT_PATHS)),
         manifest=section.get("manifest", "compile_manifest.json"),
+        memory_tolerance=float(section.get("memory-tolerance", 0.10)),
         exclude=tuple(section.get("exclude", ("__pycache__",))),
         root=pyproject.parent,
     )

@@ -17,6 +17,17 @@ a recompile / changes serve bucket shapes / changes donation":
 - ``lowered_sha256``: digest of the full StableHLO text — the catch-all
   for structural changes. Compared only when the recorded jax version
   matches, so a toolchain bump doesn't read as a product regression.
+- ``memory`` (dcr-hbm): XLA's ``memory_analysis()`` of the COMPILED
+  program — argument/output/temp/generated-code bytes plus the
+  cost-analysis FLOPs — captured by compiling each surface on the 1-CPU
+  stub (still nothing executes). The checked-in block is the surface's
+  **byte budget**: :func:`diff_manifests` fails when a regenerated field
+  exceeds it past a configurable tolerance (``[tool.dcr-check]
+  memory-tolerance`` / ``--memory-tolerance``, default 10%), so an HBM
+  regression is a readable CI diff instead of a production OOM. Shrinkage
+  never fails (a smaller footprint needs no sign-off); fields a backend
+  omits degrade to present-field checks; versions-skewed toolchains skip
+  the comparison exactly like the HLO digest.
 
 The CI contract: ``python -m tools.check --manifest-only`` regenerates the
 manifest on a fresh checkout and fails with a readable per-field diff when
@@ -28,10 +39,23 @@ from __future__ import annotations
 
 import hashlib
 import json
+import sys
 from pathlib import Path
 from typing import Any, Optional
 
 MANIFEST_VERSION = 1
+
+#: default headroom over a banked memory-budget field before the diff fails
+#: (relative); config/CLI override it. The absolute slack keeps noise-level
+#: byte wiggle on near-zero fields (a 0-byte temp growing to one scratch
+#: word) from failing CI — anything under a page is not an HBM regression.
+DEFAULT_MEMORY_TOLERANCE = 0.10
+MEMORY_SLACK_BYTES = 4096
+
+#: memory fields the budget applies to — flops rides along because a FLOPs
+#: regression is the same class of silent production cost as a byte one
+_BUDGET_FIELDS = ("argument_bytes", "output_bytes", "temp_bytes",
+                  "generated_code_bytes", "total_bytes", "flops")
 
 
 def _sha(text: str) -> str:
@@ -51,11 +75,33 @@ def describe_avals(tree: Any) -> dict:
     return _describe(tree)
 
 
+def surface_memory(lowered) -> dict:
+    """dcr-hbm: compile the lowered program (on the representative 1-CPU
+    stub — a real XLA compile, still zero execution and zero weights) and
+    bank its memory analysis + cost-analysis FLOPs as the entry's ``memory``
+    block. Empty dict when the backend offers no analysis or the compile
+    fails — consumers do present-field checks, so an absent block simply
+    means "no budget banked for this surface"."""
+    from dcr_tpu.obs.memwatch import memory_block
+
+    try:
+        compiled = lowered.compile()
+    except Exception as e:
+        # loud on stderr, not fatal: a surface that cannot compile on the
+        # stub still fingerprints abstractly — only its budget is absent
+        print(f"dcr-check: memory accounting skipped "
+              f"(compile failed: {e!r})", file=sys.stderr)
+        return {}
+    return memory_block(compiled) or {}
+
+
 def fingerprint(name: str, fn, args: tuple, *, static_config: dict,
                 donate_argnums: tuple = (), surface: str = "",
                 variant: str = "default") -> dict:
-    """Lower ``fn(*args)`` (abstract: no devices, no execution) and reduce
-    it to one manifest entry."""
+    """Lower ``fn(*args)`` and reduce it to one manifest entry. Lowering is
+    abstract (no weights, nothing executes); the ``memory`` block
+    additionally pays one XLA compile on the 1-CPU stub to read the
+    program's memory analysis."""
     import jax
 
     lowered = fn.lower(*args)
@@ -72,6 +118,7 @@ def fingerprint(name: str, fn, args: tuple, *, static_config: dict,
         "in_avals": describe_avals(args),
         "out_avals": describe_avals(out_info),
         "lowered_sha256": _sha(text),
+        "memory": surface_memory(lowered),
     }
 
 
@@ -120,7 +167,45 @@ def _diff_avals(prefix: str, old: dict, new: dict, lines: list[str]) -> None:
         lines.append(f"    + {added}")
 
 
-def diff_manifests(old: Optional[dict], new: dict) -> list[str]:
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def diff_memory(key: str, old_mem: dict, new_mem: dict,
+                tolerance: float) -> list[str]:
+    """dcr-hbm budget check for one entry: the checked-in ``memory`` block
+    is the surface's byte budget; a regenerated field exceeding it past
+    ``tolerance`` (relative, plus a fixed near-zero slack) is a failure
+    line. Present-field only (a backend that omits a field banks no budget
+    for it), shrinkage never fails, and the caller gates on matching jax
+    versions — a toolchain's different allocator is not a product
+    regression."""
+    lines: list[str] = []
+    for fld in _BUDGET_FIELDS:
+        if fld not in old_mem or fld not in new_mem:
+            continue
+        budget = old_mem[fld] * (1.0 + tolerance) + MEMORY_SLACK_BYTES
+        if new_mem[fld] > budget:
+            grew = (100.0 * (new_mem[fld] - old_mem[fld])
+                    / max(old_mem[fld], 1))
+            unit = ((lambda v: f"{v:.3g}") if fld == "flops"
+                    else _human_bytes)
+            lines.append(
+                f"  memory.{fld}: {unit(old_mem[fld])} -> "
+                f"{unit(new_mem[fld])} (+{grew:.1f}% > the banked budget "
+                f"+{100 * tolerance:.0f}% — this surface's device footprint "
+                "regressed; an OOM in production is how this shows up "
+                "unbudgeted. If intentional, --update-manifest)")
+    return lines
+
+
+def diff_manifests(old: Optional[dict], new: dict, *,
+                   memory_tolerance: float = DEFAULT_MEMORY_TOLERANCE
+                   ) -> list[str]:
     """Human-readable difference report; empty means the compile surface is
     unchanged. Every line names the entry and the field so the CI failure
     reads as 'what recompiles and why'."""
@@ -169,11 +254,18 @@ def diff_manifests(old: Optional[dict], new: dict) -> list[str]:
                 "structural change inside the program; expected for any "
                 "edit to the surface's compute, but verify it was "
                 "intentional)")
+        if same_jax:
+            # dcr-hbm: the banked memory block is the surface's byte budget.
+            # Same-jax only — a different toolchain's allocator/codegen is
+            # not a product regression (mirrors the HLO-digest rule).
+            entry_lines.extend(diff_memory(
+                key, o.get("memory") or {}, n.get("memory") or {},
+                memory_tolerance))
         if entry_lines:
             lines.append(f"{key}:")
             lines.extend(entry_lines)
     if lines and not same_jax:
         lines.append(f"note: recorded jax {old.get('jax_version')} vs "
-                     f"current {new.get('jax_version')} — HLO digests were "
-                     "not compared")
+                     f"current {new.get('jax_version')} — HLO digests and "
+                     "memory budgets were not compared")
     return lines

@@ -17,7 +17,12 @@ representative config, and hands (fn, abstract args, static knobs) to
   regardless of host core count or ``xla_force_host_platform_device_count``;
 - everything is lowered abstractly (ShapeDtypeStruct args, eval_shape'd
   param trees) — no weights exist, nothing executes, no devices beyond the
-  one CPU stub are touched.
+  one CPU stub are touched. The ``memory`` block (dcr-hbm) additionally
+  pays ONE XLA compile per surface on that stub to read
+  ``memory_analysis()``/``cost_analysis()`` — still zero execution; the
+  banked bytes are the surface's budget that
+  :func:`tools.check.manifest.diff_manifests` enforces with a configurable
+  tolerance.
 
 Adding a surface: decorate the builder with ``@compile_surface``, append a
 spec here covering that surface name, then ``python -m tools.check

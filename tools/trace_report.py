@@ -17,6 +17,10 @@ validated against the checked-in ``tools/trace_schema.json``. The report:
   recompile count per bucket (``serve/compile`` events);
 - fault timeline: every ``fault/*`` event in chronological order, plus any
   flight-recorder dumps present in the directory;
+- memory (dcr-hbm): resident-delta per stage and a peak timeline from the
+  ``hbm_peak``/``hbm_delta`` attrs hot-region spans carry on backends with
+  ``memory_stats()``, plus the compiled surfaces ranked by XLA temp bytes
+  (``memwatch/surface_memory`` events);
 - copy risk (dcr-watch): flagged-generation count, gen↔train similarity
   percentiles (from ``serve/risk_score`` / ``risk/score`` span ``sims``),
   the most-hit train keys, and a flagged-request timeline from
@@ -451,6 +455,68 @@ def pipeline_summary(records: list[dict]) -> dict | None:
     }
 
 
+def memory_summary(records: list[dict]) -> dict | None:
+    """The "Memory" section (dcr-hbm): where the device memory went.
+
+    Built from two record families: hot-region spans carrying
+    ``hbm_peak``/``hbm_delta`` attrs (``train/step``, ``train/encode``,
+    ``serve/device_step`` — obs/memwatch.span_hbm; only emitted on backends
+    with real ``memory_stats()``), and ``memwatch/surface_memory`` events
+    (one per AOT-compiled surface, carrying its XLA memory analysis).
+    None when nothing carries memory info — CPU-backend traces keep their
+    pre-dcr-hbm report shape.
+
+    - ``resident_delta_by_stage``: summed ``hbm_delta`` per span name — the
+      stages that grew (or released) resident memory;
+    - ``peak_timeline``: the last 50 ``hbm_peak`` samples in time order —
+      how the high-water mark moved across the run;
+    - ``top_surfaces_by_temp_bytes``: the compiled programs ranked by XLA
+      temp (scratch) bytes — the first place to look when a peak says the
+      device is fuller than the params explain.
+    """
+    spans = [r for r in records
+             if r["ph"] == "X" and "hbm_peak" in r["args"]]
+    surfaces: dict[str, dict] = {}
+    for r in records:
+        if r["ph"] == "i" and r["name"] == "memwatch/surface_memory":
+            label = (f"{r['args'].get('surface', '?')}"
+                     f"@{str(r['args'].get('key', ''))[:8]}")
+            surfaces[label] = r["args"]
+    if not spans and not surfaces:
+        return None
+    by_stage: dict[str, dict] = {}
+    for s in sorted(spans, key=lambda r: r["ts"]):
+        row = by_stage.setdefault(
+            s["name"], {"count": 0, "delta_bytes": 0, "peak_bytes": 0})
+        row["count"] += 1
+        row["delta_bytes"] += int(s["args"].get("hbm_delta", 0))
+        row["peak_bytes"] = max(row["peak_bytes"],
+                                int(s["args"].get("hbm_peak", 0)))
+    timeline = [{"ts": s["ts"], "peak_bytes": int(s["args"]["hbm_peak"])}
+                for s in sorted(spans, key=lambda r: r["ts"])][-50:]
+    top = sorted(
+        surfaces.items(),
+        key=lambda kv: -(kv[1].get("temp_bytes") or 0))[:10]
+    return {
+        "sampled_spans": len(spans),
+        # over ALL spans, not the truncated timeline: in a merged fleet
+        # trace the process that peaked highest may have died early, and
+        # its samples must not fall out of the headline number
+        "peak_bytes": max((int(s["args"]["hbm_peak"]) for s in spans),
+                          default=0),
+        "resident_delta_by_stage": by_stage,
+        "peak_timeline": timeline,
+        "surfaces": len(surfaces),
+        "top_surfaces_by_temp_bytes": [{
+            "surface": label,
+            "temp_bytes": mem.get("temp_bytes"),
+            "argument_bytes": mem.get("argument_bytes"),
+            "output_bytes": mem.get("output_bytes"),
+            "total_bytes": mem.get("total_bytes"),
+        } for label, mem in top],
+    }
+
+
 def fast_sampling_summary(records: list[dict]) -> dict | None:
     """The "Fast sampling" section (dcr-fast): denoiser-call reduction from
     ``sample/fast`` spans — one per accelerated batch EXECUTION, carrying
@@ -584,6 +650,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "copy_risk": copy_risk_summary(records),
         "fast_sampling": fast_sampling_summary(records),
         "pipeline": pipeline_summary(records),
+        "memory": memory_summary(records),
         "fault_timeline": faults,
         "fleet": fleet_summary(records, meta or {}),
     }
@@ -696,6 +763,20 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
             f"{fast['calls_saved_total']} saved)")
         for saved, count in fast["calls_saved_histogram"].items():
             lines.append(f"  {count}x trajectories saved {saved} call(s)")
+    mem = summary.get("memory")
+    if mem:
+        lines.append(
+            f"\nmemory: peak {mem['peak_bytes']} bytes across "
+            f"{mem['sampled_spans']} sampled span(s), "
+            f"{mem['surfaces']} compiled surface(s) accounted")
+        for name, row in sorted(mem["resident_delta_by_stage"].items(),
+                                key=lambda kv: -abs(kv[1]["delta_bytes"])):
+            lines.append(f"  {name:<24} x{row['count']:<6} resident delta "
+                         f"{row['delta_bytes']:+d} B  peak "
+                         f"{row['peak_bytes']} B")
+        for s in mem["top_surfaces_by_temp_bytes"][:5]:
+            lines.append(f"  surface {s['surface']:<40} temp "
+                         f"{s['temp_bytes']} B  total {s['total_bytes']} B")
     risk = summary.get("copy_risk")
     if risk:
         lines.append(f"\ncopy risk: {risk['scored']} generation(s) scored, "
