@@ -1,13 +1,22 @@
-"""dcr-search: LAION pipeline (reference embedding_search/ scripts).
+"""dcr-search: LAION pipeline (reference embedding_search/ scripts) plus
+the dcr-store sharded-store workflow.
 
 Subcommands:
     download  --parquet_path=... --laion_folder=...
     embed     --gen_folder=<images-or-tars-dir> [--embedding_out=...]
     search    --gen_folder=... --laion_folder=<dir-of-chunk-dirs> --out_path=...
+              [--store_dir=<built store>]   # store-backed instead of brute force
+    build     --store_dir=... --laion_folder=<dir-of-chunk-dirs> [--dumps=a.npz,b.pkl]
+              [--shard_rows=N] [--store_normalize=true]
+    append    --store_dir=... --laion_folder=... [--dumps=...]
+    verify    --store_dir=...            # read-only; exit 1 on corrupt shards
+    query     --store_dir=... --gen_folder=... --out_path=... [--top_k=K]
+              [--query_batch=B] [--segment_rows=R] [--warm_dir=...]
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 from pathlib import Path
@@ -15,6 +24,53 @@ from pathlib import Path
 from dcr_tpu.core.config import SearchConfig, parse_cli
 from dcr_tpu.search import embed as E
 from dcr_tpu.search import search as S
+
+USAGE = ("usage: dcr-search {download|embed|search|build|append|verify|query}"
+         " --key=value ...")
+
+
+def _store_sources(cfg: SearchConfig) -> list:
+    sources = [Path(p) for p in cfg.dumps]
+    if cfg.laion_folder:
+        sources.append(Path(cfg.laion_folder))
+    if not sources:
+        raise SystemExit(
+            "build/append needs --laion_folder=<dir> and/or --dumps=<files>")
+    return sources
+
+
+def _cmd_build(cfg: SearchConfig, append: bool) -> None:
+    from dcr_tpu.search.store import EmbeddingStoreWriter, ingest_dumps
+
+    if not cfg.store_dir:
+        raise SystemExit("build/append needs --store_dir=<dir>")
+    writer = (EmbeddingStoreWriter.append(cfg.store_dir) if append
+              else EmbeddingStoreWriter.create(
+                  cfg.store_dir, shard_rows=cfg.shard_rows,
+                  normalize=cfg.store_normalize))
+    report = ingest_dumps(writer, _store_sources(cfg))
+    print(json.dumps(report, indent=1, sort_keys=True))
+
+
+def _cmd_verify(cfg: SearchConfig) -> None:
+    from dcr_tpu.search.store import EmbeddingStoreReader
+
+    if not cfg.store_dir:
+        raise SystemExit("verify needs --store_dir=<dir>")
+    # read-only on purpose: inspecting a possibly-shared store must not
+    # quarantine-rename anything out from under its other readers
+    reader = EmbeddingStoreReader(cfg.store_dir, quarantine=False)
+    report = reader.verify()
+    print(json.dumps(report, indent=1, sort_keys=True))
+    if report["corrupt"]:
+        raise SystemExit(1)
+
+
+def _cmd_query(cfg: SearchConfig) -> None:
+    if not cfg.store_dir:
+        raise SystemExit("query needs --store_dir=<dir>")
+    out = S.run_search(cfg)
+    print(f"search results -> {out}")
 
 
 def main(argv=None) -> None:
@@ -25,9 +81,13 @@ def main(argv=None) -> None:
                         format="%(asctime)s %(name)s %(message)s")
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0].startswith("--"):
-        raise SystemExit("usage: dcr-search {download|embed|search} --key=value ...")
+        raise SystemExit(USAGE)
     command, rest = argv[0], argv[1:]
     cfg = parse_cli(SearchConfig, rest)
+    if cfg.logdir:
+        from dcr_tpu.core import tracing
+
+        tracing.configure(cfg.logdir)
     if command == "download":
         E.download_laion_chunk(cfg.parquet_path, cfg.laion_folder,
                                image_size=cfg.image_size)
@@ -38,8 +98,19 @@ def main(argv=None) -> None:
         E.embed_images(cfg, source=cfg.gen_folder,
                        out_path=cfg.embedding_out or None)
     elif command == "search":
-        folders = sorted(p for p in Path(cfg.laion_folder).iterdir() if p.is_dir())
+        folders = ()
+        if not cfg.store_dir:
+            folders = sorted(p for p in Path(cfg.laion_folder).iterdir()
+                             if p.is_dir())
         S.run_search(cfg, laion_folders=folders)
+    elif command == "build":
+        _cmd_build(cfg, append=False)
+    elif command == "append":
+        _cmd_build(cfg, append=True)
+    elif command == "verify":
+        _cmd_verify(cfg)
+    elif command == "query":
+        _cmd_query(cfg)
     else:
         raise SystemExit(f"unknown subcommand {command!r}")
 

@@ -330,6 +330,12 @@ class RiskConfig:
     # train-set embedding dump: search/embed.py .npz format, or the
     # reference toolchain's pickle {'features','indexes'} ("" = disabled)
     index_path: str = ""
+    # dcr-store alternative: a built sharded embedding store (dcr-search
+    # build). Takes precedence over index_path; scoring runs through the
+    # mesh-sharded search/topk engine, so the corpus no longer has to fit
+    # one device-resident matmul operand.
+    store_dir: str = ""
+    segment_rows: int = 0     # rows per device segment for store mode; 0=auto
     # SSCD backbone weights (torch state dict / TorchScript archive,
     # converted on load). "" = deterministic random init — self-consistent
     # (an index embedded with the same init scores correctly) but NOT
@@ -666,7 +672,13 @@ class EvalConfig:
 
 @dataclass
 class SearchConfig:
-    """LAION-scale embedding search (reference embedding_search/)."""
+    """LAION-scale embedding search (reference embedding_search/).
+
+    The dcr-store fields drive the sharded-store workflow (``dcr-search
+    build/append/verify/query``): embeddings ingested once into a
+    manifest-keyed sha256-verified shard store (``store_dir``), then
+    queried through the mesh-sharded ``search/topk`` engine instead of the
+    per-folder brute-force chunk loop."""
 
     parquet_path: str = ""
     laion_folder: str = ""
@@ -678,6 +690,16 @@ class SearchConfig:
     image_size: int = 224
     delete_tars: bool = False
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    # -- dcr-store: sharded embedding store + device-sharded top-k ----------
+    store_dir: str = ""          # built store; "" = brute-force folder scan
+    dumps: tuple[str, ...] = ()  # extra dump files/dirs for build/append
+    shard_rows: int = 4096       # rows per store shard file (ingest unit)
+    store_normalize: bool = False  # L2-normalize rows at ingest (cosine)
+    top_k: int = 1               # nearest corpus keys kept per query
+    query_batch: int = 64        # fixed compiled query-batch shape
+    segment_rows: int = 0        # rows per device segment; 0 = auto
+    warm_dir: str = ""           # persistent executable cache (dcr-warm)
+    logdir: str = ""             # trace.jsonl sink for search/* spans
 
 
 # ---------------------------------------------------------------------------

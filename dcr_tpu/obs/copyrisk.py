@@ -143,7 +143,10 @@ def _quarantine_dump(path: Path, reason: str, quarantine: bool) -> None:
     R.log_event("risk_index_corrupt", path=str(path), error=reason)
     R.bump_counter("copy_risk/index_corrupt_total")
     if quarantine:
+        from dcr_tpu.search.embed import quarantine_sidecar
+
         dest = warmcache.quarantine_rename(path)
+        quarantine_sidecar(path)
         if dest is not None:
             log.warning("copyrisk: quarantined corrupt dump %s -> %s",
                         path, dest.name)
@@ -255,38 +258,78 @@ class CopyRiskIndex:
     ``score_batch`` is thread-safe after :meth:`build` (the serve worker
     thread and /check handler threads share one index); ``build`` itself is
     serialized by an internal lock and idempotent.
+
+    Two backends, one API: a **dense** whole-dump-resident index
+    (``cfg.index_path`` — the original dcr-watch mode, one ``risk/score``
+    matmul over a device-resident operand) or a **store-backed** index
+    (``cfg.store_dir`` — dcr-store: a sharded embedding store scored
+    segment-by-segment through the mesh-sharded ``search/topk`` engine, so
+    the corpus no longer has to fit one device).
     """
 
-    def __init__(self, features: np.ndarray, keys: Sequence[str],
+    def __init__(self, features: Optional[np.ndarray],
+                 keys: Optional[Sequence[str]],
                  cfg: RiskConfig, *, batch: int,
-                 warm_dir: str = ""):
-        features = verify_risk_dump(features, keys)
-        norms = np.linalg.norm(features, axis=-1, keepdims=True)
-        self._features_host = features / np.maximum(norms, 1e-12)
-        self.keys = [str(k) for k in keys]
+                 warm_dir: str = "", store=None):
+        self._store = store           # EmbeddingStoreReader (store mode)
+        if store is None:
+            features = verify_risk_dump(features, keys)
+            norms = np.linalg.norm(features, axis=-1, keepdims=True)
+            self._features_host = features / np.maximum(norms, 1e-12)
+            self.keys = [str(k) for k in keys]
+            n_index = len(self.keys)
+        else:
+            if store.embed_dim != EMBED_DIM:
+                raise RiskIndexError(
+                    f"embedding store width {store.embed_dim} != SSCD embed "
+                    f"dim {EMBED_DIM} — wrong backbone")
+            if store.total <= 0:
+                raise RiskIndexError(
+                    f"embedding store {store.dir} holds no rows")
+            self._features_host = None
+            self.keys = []            # never materialized in store mode
+            n_index = store.total
         self.cfg = cfg
         self.batch = int(batch)
-        self.top_k = min(int(cfg.top_k), len(self.keys))
+        self.top_k = min(int(cfg.top_k), n_index)
         self.warm_dir = warm_dir
         self._lock = threading.Lock()
         self._built = False
         self._feats_dev = None
         self._extract = None
         self._score = None
+        self._engine = None           # ShardedTopK (store mode)
 
     def __len__(self) -> int:
-        return len(self.keys)
+        return self._store.total if self._store is not None \
+            else len(self.keys)
 
     # -- construction --------------------------------------------------------
 
     @classmethod
     def load(cls, cfg: RiskConfig, *, batch: int, warm_dir: str = "",
              build: bool = True) -> "CopyRiskIndex":
-        """Load ``cfg.index_path``, optionally build the compiled pipeline
-        eagerly (so a status of "ok" means scoring is READY, not hoped-for).
-        Raises :class:`RiskIndexError` on a bad dump."""
-        features, keys = load_risk_dump(cfg.index_path)
-        index = cls(features, keys, cfg, batch=batch, warm_dir=warm_dir)
+        """Load ``cfg.store_dir`` (dcr-store sharded store; takes
+        precedence) or ``cfg.index_path`` (whole dump), optionally build
+        the compiled pipeline eagerly (so a status of "ok" means scoring is
+        READY, not hoped-for). Raises :class:`RiskIndexError` on a bad
+        dump/store."""
+        if cfg.store_dir:
+            from dcr_tpu.search.store import EmbeddingStoreReader, StoreError
+
+            try:
+                reader = EmbeddingStoreReader(cfg.store_dir)
+            except StoreError as e:
+                R.log_event("risk_store_invalid", path=cfg.store_dir,
+                            error=str(e))
+                R.bump_counter("copy_risk/index_invalid_total")
+                raise RiskIndexError(
+                    f"embedding store {cfg.store_dir}: {e}") from e
+            index = cls(None, None, cfg, batch=batch, warm_dir=warm_dir,
+                        store=reader)
+        else:
+            features, keys = load_risk_dump(cfg.index_path)
+            index = cls(features, keys, cfg, batch=batch, warm_dir=warm_dir)
         if build:
             index.build()
         return index
@@ -349,22 +392,40 @@ class CopyRiskIndex:
             # the whole backbone on every scored batch
             sscd_params = jax.device_put(extractor.args[0])
             self._extract = lambda imgs: embed(sscd_params, imgs)
-            feats_dev = jnp.asarray(self._features_host)
-            scorer_jit = make_risk_scorer(self.top_k)
-            q_aval = jax.ShapeDtypeStruct((self.batch, EMBED_DIM),
-                                          jnp.float32)
-            sres = warmcache.aot_compile(
-                "risk/score", scorer_jit, (feats_dev, q_aval),
-                static_config={"top_k": self.top_k,
-                               "index_size": len(self.keys),
-                               "batch": self.batch},
-                cache=cache)
-            self._score = warmcache.guarded(sres.fn, scorer_jit, "risk/score")
-            self._feats_dev = feats_dev
+            if self._store is not None:
+                # store-backed scoring: the mesh-sharded search/topk engine
+                # (cosine: queries normalized in-program, index rows
+                # normalized host-side at segment load unless the store was
+                # built normalized)
+                from dcr_tpu.search.shardindex import ShardedTopK
+
+                self._engine = ShardedTopK(
+                    self._store, mesh=mesh, top_k=self.top_k,
+                    query_batch=self.batch,
+                    segment_rows=self.cfg.segment_rows,
+                    normalize_queries=True,
+                    normalize_rows=not self._store.normalized,
+                    warm_dir=self.warm_dir).build()
+                scorer_src = "store"
+            else:
+                feats_dev = jnp.asarray(self._features_host)
+                scorer_jit = make_risk_scorer(self.top_k)
+                q_aval = jax.ShapeDtypeStruct((self.batch, EMBED_DIM),
+                                              jnp.float32)
+                sres = warmcache.aot_compile(
+                    "risk/score", scorer_jit, (feats_dev, q_aval),
+                    static_config={"top_k": self.top_k,
+                                   "index_size": len(self.keys),
+                                   "batch": self.batch},
+                    cache=cache)
+                self._score = warmcache.guarded(sres.fn, scorer_jit,
+                                                "risk/score")
+                self._feats_dev = feats_dev
+                scorer_src = sres.source
             self._built = True
             log.info("copyrisk: index ready — %d train embeddings, batch=%d, "
-                     "top_k=%d (extractor %s, scorer %s)", len(self.keys),
-                     self.batch, self.top_k, res.source, sres.source)
+                     "top_k=%d (extractor %s, scorer %s)", len(self),
+                     self.batch, self.top_k, res.source, scorer_src)
         return self
 
     # -- scoring -------------------------------------------------------------
@@ -389,6 +450,13 @@ class CopyRiskIndex:
             prep = np.concatenate(
                 [prep, np.repeat(prep[-1:], self.batch - n, axis=0)])
         feats = self._extract(prep)
+        if self._engine is not None:
+            sims, key_rows = self._engine.query(np.asarray(feats)[:n])
+            return [RiskScore(max_sim=float(row_sims[0]),
+                              top_key=str(row_keys[0]),
+                              topk=[(str(k), float(s))
+                                    for s, k in zip(row_sims, row_keys)])
+                    for row_sims, row_keys in zip(sims, key_rows)]
         sims, idx = self._score(self._feats_dev, feats)
         sims = np.asarray(sims)[:n]
         idx = np.asarray(idx)[:n]

@@ -1,2 +1,4 @@
 """L4d: LAION-scale embedding pipeline — download orchestration, embedding
-dumps, chunked sharded max-inner-product search."""
+dumps, chunked brute-force search, and the dcr-store scale path: a sharded
+sha256-verified embedding store (store.py) queried through a mesh-sharded
+top-k engine (shardindex.py)."""

@@ -13,8 +13,12 @@ SURVEY.md §2.4) has no equivalent here by construction.
 
 from __future__ import annotations
 
+import hashlib
 import io
+import itertools
+import json
 import logging
+import os
 import pickle
 import tarfile
 from pathlib import Path
@@ -95,24 +99,154 @@ def iter_webdataset_images(tar_paths: list[Path], image_size: int,
                 yield f"{tar_path.stem}/{Path(member.name).stem}", arr
 
 
+class EmbeddingDumpError(RuntimeError):
+    """Typed: an embedding dump failed sidecar verification (sha256 or
+    row-count mismatch) — a torn/bit-rotted dump detected at LOAD instead
+    of producing a wrong similarity table. Callers treat it like any other
+    corrupt-dump parse failure (quarantine at the search/copyrisk layer)."""
+
+
+#: per-process dump-read index — the ``load`` coordinate of the
+#: ``search_dump_corrupt`` fault kind (utils/faults.py)
+_load_seq = itertools.count()
+
+
+def reset_dump_load_seq() -> None:
+    """Restart the ``load`` coordinate at 0 (tests/harnesses that install a
+    ``search_dump_corrupt@load=N`` spec mid-process; a fresh process — the
+    DCR_FAULTS env path — starts at 0 by construction)."""
+    global _load_seq
+    _load_seq = itertools.count()
+
+
+def _sidecar_path(path: Path) -> Path:
+    return path.with_name(path.name + ".sha256")
+
+
 def save_embeddings(path: str | Path, features: np.ndarray,
-                    indexes: list[str]) -> None:
-    np.savez_compressed(path, features=np.asarray(features, np.float32),
-                        indexes=np.asarray(indexes))
+                    indexes: list[str]) -> Path:
+    """Write a dump plus its integrity sidecar (``<name>.sha256``: payload
+    sha256 + row count), so a torn write is detected at load time. The
+    sidecar commits AFTER the dump (both atomically): a crash between the
+    two leaves a dump without a sidecar — readable, just unverified, like
+    a reference-toolchain dump. Returns the path actually written:
+    ``.npz`` is appended when missing (``np.savez_compressed`` semantics —
+    and :func:`load_embeddings` dispatches npz-vs-pickle on the suffix, so
+    an npz payload must never sit under a pickle-looking name)."""
+    path = Path(path)
+    if not path.name.endswith(".npz"):
+        path = path.with_name(path.name + ".npz")
+    features = np.asarray(features, np.float32)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, features=features, indexes=np.asarray(indexes))
+    blob = buf.getvalue()
+    tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
+    tmp.write_bytes(blob)
+    os.replace(tmp, path)
+    side = _sidecar_path(path)
+    side_tmp = side.with_name(f"{side.name}.tmp.{os.getpid()}")
+    side_tmp.write_text(json.dumps(
+        {"sha256": hashlib.sha256(blob).hexdigest(),
+         "rows": int(features.shape[0]), "bytes": len(blob)},
+        sort_keys=True) + "\n")
+    os.replace(side_tmp, side)
+    return path
+
+
+def quarantine_sidecar(path: str | Path) -> None:
+    """Rename a quarantined dump's ``.sha256`` sidecar along with it. A
+    stale sidecar left behind would condemn ANY future replacement dump
+    (restored from backup, regenerated by another writer) to a false
+    sha-mismatch quarantine loop."""
+    from dcr_tpu.core.warmcache import quarantine_rename
+
+    side = _sidecar_path(Path(path))
+    if side.exists():
+        quarantine_rename(side)
+
+
+def _read_sidecar(path: Path) -> Optional[dict]:
+    side = _sidecar_path(path)
+    if not side.exists():
+        return None          # reference dumps / pre-sidecar dumps: unverified
+    try:
+        doc = json.loads(side.read_text())
+        if not isinstance(doc.get("sha256"), str) or \
+                not isinstance(doc.get("rows"), int):
+            raise ValueError("sidecar missing sha256/rows")
+        return doc
+    except (OSError, ValueError) as e:
+        # a corrupt SIDECAR must not take down a possibly-fine dump: load
+        # proceeds unverified, loudly
+        from dcr_tpu.core import resilience as R
+
+        R.log_event("search_dump_sidecar_unreadable", path=str(side),
+                    error=repr(e))
+        from dcr_tpu.core import tracing
+
+        tracing.registry().counter("search/dump_sidecar_unreadable").inc()
+        return None
 
 
 def load_embeddings(path: str | Path) -> tuple[np.ndarray, list[str]]:
-    """Read our .npz dumps or the reference's pickle format."""
+    """Read our .npz dumps or the reference's pickle format.
+
+    When an integrity sidecar exists (``save_embeddings`` writes one), the
+    payload sha256 and row count are verified and a mismatch raises a typed
+    :class:`EmbeddingDumpError` — the ``search_dump_corrupt@load=N`` fault
+    kind damages the Nth verified read in memory so CI drives this path
+    deterministically. Dumps without a sidecar (the reference toolchain's)
+    load unverified, exactly as before."""
+    from dcr_tpu.core import resilience as R
+    from dcr_tpu.core import tracing
+    from dcr_tpu.utils import faults
+
     path = Path(path)
-    if path.suffix == ".npz" or path.name.endswith(".npz"):
-        with np.load(path, allow_pickle=False) as z:
-            return np.asarray(z["features"], np.float32), [str(i) for i in z["indexes"]]
-    with open(path, "rb") as f:
-        d = pickle.load(f)
-    feats = d["features"]
-    if hasattr(feats, "numpy"):  # torch tensor from the reference toolchain
-        feats = feats.numpy()
-    return np.asarray(feats, np.float32), [str(i) for i in d["indexes"]]
+    sidecar = _read_sidecar(path)
+    if sidecar is not None:
+        # retry transient I/O so a momentary NFS hiccup surfaces as OSError
+        # only after backoff — callers treat OSError as "skip, keep the
+        # dump", never as corruption (see search.load_folder_embeddings)
+        blob = R.read_bytes_with_retry(path,
+                                       name=f"embedding_dump:{path.name}")
+        if faults.fire("search_dump_corrupt", load=next(_load_seq)):
+            # deterministic CI poisoning: damage the payload in memory so
+            # the REAL verification path runs end to end
+            mid = len(blob) // 2
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] \
+                if blob else b""
+        if hashlib.sha256(blob).hexdigest() != sidecar["sha256"]:
+            tracing.registry().counter("search/dump_corrupt").inc()
+            raise EmbeddingDumpError(
+                f"embedding dump {path} fails its sha256 sidecar — torn or "
+                "bit-rotted dump")
+        source = io.BytesIO(blob)
+    else:
+        # no sidecar (reference toolchain dumps): nothing to verify, so
+        # parse straight from the file instead of holding the raw blob AND
+        # the parsed arrays in memory at once (LAION chunks are GB-scale)
+        source = path
+    if path.name.endswith(".npz"):
+        with np.load(source, allow_pickle=False) as z:
+            features = np.asarray(z["features"], np.float32)
+            keys = [str(i) for i in z["indexes"]]
+    else:
+        if isinstance(source, io.BytesIO):
+            d = pickle.load(source)
+        else:
+            with open(source, "rb") as f:
+                d = pickle.load(f)
+        feats = d["features"]
+        if hasattr(feats, "numpy"):  # torch tensor from the reference toolchain
+            feats = feats.numpy()
+        features = np.asarray(feats, np.float32)
+        keys = [str(i) for i in d["indexes"]]
+    if sidecar is not None and features.shape[0] != sidecar["rows"]:
+        tracing.registry().counter("search/dump_corrupt").inc()
+        raise EmbeddingDumpError(
+            f"embedding dump {path} has {features.shape[0]} rows but its "
+            f"sidecar recorded {sidecar['rows']} — torn dump")
+    return features, keys
 
 
 def find_embedding_file(folder: str | Path) -> Optional[Path]:
@@ -168,8 +302,8 @@ def embed_images(cfg: SearchConfig, *, source: str | Path,
         features = extract_features(folder, extractor, batch_size=cfg.batch_size)
         keys = [str(p) for p in folder.paths]
 
-    out_path = Path(out_path or (source / "embedding.npz"))
-    save_embeddings(out_path, features, keys)
+    out_path = save_embeddings(Path(out_path or (source / "embedding.npz")),
+                               features, keys)
     log.info("embedded %d images from %s -> %s", len(keys), source, out_path)
     return out_path
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 from dcr_tpu.core.compile_surface import compile_surface
 from dcr_tpu.core.config import SearchConfig
@@ -40,12 +41,64 @@ def make_search_matmul():
 
 def topk_merge(scores: np.ndarray, keys: np.ndarray, new_scores: np.ndarray,
                new_keys: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """Merge two [N, K] top-k tables (scores desc) into one."""
-    all_scores = np.concatenate([scores, new_scores], axis=1)
-    all_keys = np.concatenate([keys, new_keys], axis=1)
-    order = np.argsort(-all_scores, axis=1)[:, : scores.shape[1]]
-    return (np.take_along_axis(all_scores, order, axis=1),
-            np.take_along_axis(all_keys, order, axis=1))
+    """Merge two [N, K] top-k tables (scores desc) into one. Delegates to
+    the store engine's merge so the brute-force and store-backed paths can
+    never drift on merge semantics."""
+    from dcr_tpu.search.shardindex import merge_topk
+
+    return merge_topk(scores, keys, new_scores, new_keys)
+
+
+def load_folder_embeddings(emb_file: Path, *, quarantine: bool = True):
+    """Load one folder's dump under the copyrisk/latent-cache
+    verify-before-load contract, or None when the folder can't serve.
+
+    An UNREADABLE dump (truncated zip, bit-flipped pickle, sha-sidecar
+    mismatch) is genuinely corrupt: quarantine-renamed so no later search
+    retries known-bad bytes, counted (``search/folder_corrupt``), and
+    logged. A READABLE dump that merely fails validation (features/keys
+    row-count mismatch, non-2D features) stays IN PLACE — it may be a valid
+    artifact of the wrong kind that a rerun will replace — counted as
+    ``search/folder_invalid``. Nothing is ever swallowed silently."""
+    from dcr_tpu.core.warmcache import quarantine_rename
+
+    reg = tracing.registry()
+    try:
+        feats, keys = load_embeddings(emb_file)
+    except OSError as e:
+        # transient read failure (NFS timeout, EINTR) that survived the
+        # retry tier is NOT evidence of corruption: skip this search, keep
+        # the dump — quarantining would permanently shrink the corpus over
+        # a flaky mount
+        R.log_event("search_folder_read_error", path=str(emb_file),
+                    error=repr(e))
+        reg.counter("search/folder_read_error").inc()
+        log.warning("unreadable (I/O) embedding dump %s (%r); left in "
+                    "place, skipping", emb_file, e)
+        return None
+    except Exception as e:  # unreadable/corrupt damage (reference 51-56)
+        from dcr_tpu.search.embed import quarantine_sidecar
+
+        dest = quarantine_rename(emb_file) if quarantine else None
+        if quarantine:
+            quarantine_sidecar(emb_file)
+        R.log_event("search_folder_corrupt", path=str(emb_file),
+                    error=repr(e),
+                    quarantined_to=str(dest) if dest else None)
+        reg.counter("search/folder_corrupt").inc()
+        log.warning("corrupt embedding dump %s (%r); quarantined -> %s",
+                    emb_file, e, dest.name if dest else "<rename failed>")
+        return None
+    feats = np.asarray(feats)
+    if feats.ndim != 2 or feats.shape[0] != len(keys):
+        R.log_event("search_folder_invalid", path=str(emb_file),
+                    shape=list(feats.shape), keys=len(keys))
+        reg.counter("search/folder_invalid").inc()
+        log.warning("invalid embedding dump %s (features %s, %d keys); "
+                    "left in place, skipping", emb_file, feats.shape,
+                    len(keys))
+        return None
+    return np.asarray(feats, np.float32), keys
 
 
 def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
@@ -73,11 +126,10 @@ def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
         if emb_file is None:
             log.warning("no embedding dump under %s; skipping", folder)
             continue
-        try:
-            feats, keys = load_embeddings(emb_file)
-        except Exception as e:  # tolerate corrupt chunks (reference 51-56)
-            log.warning("corrupt embedding dump %s (%s); skipping", emb_file, e)
+        loaded = load_folder_embeddings(emb_file)
+        if loaded is None:
             continue
+        feats, keys = loaded
         if not len(feats):
             continue
         t0 = time.time()
@@ -114,16 +166,57 @@ def search_folders(gen_features: np.ndarray, gen_keys: Sequence[str],
             "gen_images": np.asarray(list(gen_keys), dtype=object)}
 
 
-def run_search(cfg: SearchConfig, *, laion_folders: Sequence[str | Path],
+def search_store(gen_features: np.ndarray, gen_keys: Sequence[str],
+                 store_dir: str | Path, *, top_k: int = 1,
+                 mesh=None, query_batch: int = 64, segment_rows: int = 0,
+                 warm_dir: str = "") -> dict:
+    """The store-backed path of :func:`search_folders`: one device-sharded
+    top-k over a built embedding store (dcr-store) instead of the
+    per-folder host-merged chunk loop. Same result contract —
+    ``{"scores": [N,K], "keys": [N,K], "gen_images": [N]}`` — and on the
+    same embedding dump the scores and keys are EXACTLY equal to the brute
+    force (pinned by tests/test_store.py)."""
+    from dcr_tpu.search.shardindex import open_engine
+
+    n = len(gen_features)
+    if n == 0:
+        return {"scores": np.zeros((0, top_k), np.float32),
+                "keys": np.zeros((0, top_k), dtype=object),
+                "gen_images": np.asarray([], dtype=object)}
+    engine = open_engine(store_dir, mesh=mesh, top_k=top_k,
+                         query_batch=query_batch, segment_rows=segment_rows,
+                         warm_dir=warm_dir)
+    t0 = time.time()
+    scores, keys = engine.query(np.asarray(gen_features, np.float32))
+    log.info("store search: %d queries x %d rows in %.1fs", n, engine.total,
+             time.time() - t0)
+    return {"scores": scores, "keys": keys,
+            "gen_images": np.asarray(list(gen_keys), dtype=object)}
+
+
+def run_search(cfg: SearchConfig, *,
+               laion_folders: Sequence[str | Path] = (),
                top_k: int = 1) -> Path:
-    """Full stage: load gen embeddings, search all folders, dump results."""
+    """Full stage: load gen embeddings, search (store-backed when
+    ``cfg.store_dir`` names a built store, else the per-folder brute
+    force), dump results."""
     gen_emb = find_embedding_file(cfg.gen_folder)
     if gen_emb is None:
         raise FileNotFoundError(
             f"no embedding dump under {cfg.gen_folder}; run search.embed first")
     gen_features, gen_keys = load_embeddings(gen_emb)
-    result = search_folders(gen_features, gen_keys, laion_folders,
-                            top_k=top_k, num_chunks=cfg.num_chunks)
+    top_k = max(top_k, cfg.top_k)
+    if cfg.store_dir:
+        from dcr_tpu.parallel import mesh as pmesh
+
+        result = search_store(gen_features, gen_keys, cfg.store_dir,
+                              top_k=top_k, query_batch=cfg.query_batch,
+                              segment_rows=cfg.segment_rows,
+                              mesh=pmesh.make_mesh(cfg.mesh),
+                              warm_dir=cfg.warm_dir)
+    else:
+        result = search_folders(gen_features, gen_keys, laion_folders,
+                                top_k=top_k, num_chunks=cfg.num_chunks)
     out = Path(cfg.out_path)
     out.parent.mkdir(parents=True, exist_ok=True)
     np.savez(out, scores=result["scores"],

@@ -1,0 +1,462 @@
+"""dcr-store: manifest-keyed, sha256-verified sharded embedding store.
+
+The reference's ``embedding_search/`` pipeline keeps one monolithic pickle
+per LAION chunk and re-reads every chunk from disk on every search. This
+module is the first-party storage half of ROADMAP item 5: embeddings land
+in fixed-capacity shards under one manifest, so a corpus of millions of
+vectors is ingested once (streaming, from ``search/embed.py`` ``.npz``
+dumps AND the reference's pickle ``{'features','indexes'}`` format),
+verified on every read, and served to the device-sharded top-k engine
+(:mod:`dcr_tpu.search.shardindex`) segment by segment.
+
+Verification discipline (the warmcache/latent-cache/copyrisk contract):
+
+- every shard is sha256-verified from bytes BEFORE ``np.load`` touches it
+  and sanity-checked (shape, width, key count, finiteness) after;
+- a damaged shard is quarantine-renamed out of the key space
+  (:func:`dcr_tpu.core.warmcache.quarantine_rename`), counted as a
+  ``search/store_shard_corrupt`` fault, and its rows degrade to a smaller
+  corpus — losing one shard of a million-row store must not forfeit the
+  rest. The ``store_shard_corrupt@load=N`` fault kind (utils/faults.py)
+  damages the Nth shard read in memory so CI drives that path
+  deterministically;
+- the manifest commits LAST (write-to-temp + atomic rename), so a killed
+  build/append leaves either the previous valid store or the new one —
+  never a manifest naming shards that don't verify. Shards named by a
+  committed manifest are immutable: ``append`` only adds shards and
+  re-commits the manifest.
+
+Layout::
+
+    <dir>/store_manifest.json     # kind/version/embed_dim + per-shard shas
+    <dir>/shard_00000.npz         # features float32 [n, D], keys [n] str
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import time
+from io import BytesIO
+from pathlib import Path
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+from dcr_tpu.core.warmcache import quarantine_rename
+
+log = logging.getLogger("dcr_tpu")
+
+STORE_VERSION = 1
+STORE_KIND = "dcr_embedding_store"
+MANIFEST_NAME = "store_manifest.json"
+#: rows per shard file — the ingest/IO unit, NOT the query unit (the query
+#: engine regroups shards into fixed device segments)
+DEFAULT_SHARD_ROWS = 4096
+
+
+class StoreError(RuntimeError):
+    """Typed: the store directory cannot serve this caller (absent/corrupt
+    manifest, wrong kind/width, or no shard survived verification). The
+    caller decides whether that is fatal (an explicit --store_dir) or a
+    degrade (copy-risk scoring disabled)."""
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def normalize_rows(features: np.ndarray) -> np.ndarray:
+    norms = np.linalg.norm(features, axis=-1, keepdims=True)
+    return features / np.maximum(norms, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Writer: streaming build/append
+# ---------------------------------------------------------------------------
+
+class EmbeddingStoreWriter:
+    """Accumulate embedding rows and persist fixed-capacity shards.
+
+    Streaming by construction: ``add`` flushes a shard every ``shard_rows``
+    rows, so peak host memory during ingestion is one shard, not the
+    corpus. ``normalize=True`` L2-normalizes rows at ingest (recorded in
+    the manifest so query layers know whether scores are cosine); the
+    default preserves dump bytes exactly — the property the store-backed
+    search path's exact-equality pin against the brute force rests on.
+    """
+
+    def __init__(self, store_dir: str | Path, *, embed_dim: Optional[int] = None,
+                 shard_rows: Optional[int] = None, normalize: bool = False,
+                 _resume: Optional[dict] = None):
+        self.dir = Path(store_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.embed_dim = embed_dim
+        self.shard_rows = max(1, int(shard_rows or DEFAULT_SHARD_ROWS))
+        self.normalize = bool(normalize)
+        self._rows: list[tuple[np.ndarray, np.ndarray]] = []
+        self._pending = 0
+        self._shards: list[dict] = list((_resume or {}).get("shards", []))
+        self._total = int((_resume or {}).get("total", 0))
+        self._sources: list[str] = list((_resume or {}).get("sources", []))
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def create(cls, store_dir: str | Path, *, embed_dim: Optional[int] = None,
+               shard_rows: Optional[int] = None,
+               normalize: bool = False) -> "EmbeddingStoreWriter":
+        """Start a NEW store; refuses to clobber a committed one (build over
+        an existing manifest would orphan its shards — use append)."""
+        if (Path(store_dir) / MANIFEST_NAME).exists():
+            raise StoreError(
+                f"{store_dir} already holds a committed store "
+                f"({MANIFEST_NAME} exists) — use append, or point build at "
+                "a fresh directory")
+        return cls(store_dir, embed_dim=embed_dim, shard_rows=shard_rows,
+                   normalize=normalize)
+
+    @classmethod
+    def append(cls, store_dir: str | Path) -> "EmbeddingStoreWriter":
+        """Extend a committed store: new rows land in NEW shards (committed
+        shards are immutable), and the manifest re-commits atomically at
+        finalize — a crash mid-append leaves the previous store intact."""
+        manifest = read_store_manifest(Path(store_dir))
+        return cls(store_dir, embed_dim=int(manifest["embed_dim"]),
+                   shard_rows=int(manifest["shard_rows"]),
+                   normalize=bool(manifest["normalized"]),
+                   _resume=manifest)
+
+    # -- ingestion -----------------------------------------------------------
+
+    def add(self, features: np.ndarray, keys: Sequence[str]) -> int:
+        """Buffer rows; flush full shards. Raises StoreError on a width or
+        row-count mismatch BEFORE anything is written."""
+        features = np.asarray(features, np.float32)
+        if features.ndim != 2:
+            raise StoreError(
+                f"features must be [N, D], got shape {features.shape}")
+        if len(keys) != features.shape[0]:
+            raise StoreError(
+                f"{features.shape[0]} features but {len(keys)} keys — "
+                "torn input")
+        if self.embed_dim is None:
+            self.embed_dim = int(features.shape[1])
+        if features.shape[1] != self.embed_dim:
+            raise StoreError(
+                f"embedding width {features.shape[1]} != store width "
+                f"{self.embed_dim}")
+        if not np.isfinite(features).all():
+            raise StoreError("input features contain non-finite values")
+        if self.normalize:
+            features = normalize_rows(features)
+        self._rows.append((features, np.asarray([str(k) for k in keys],
+                                                dtype=str)))
+        self._pending += features.shape[0]
+        while self._pending >= self.shard_rows:
+            self._flush_shard(self.shard_rows)
+        return features.shape[0]
+
+    def add_dump(self, path: str | Path) -> int:
+        """Ingest one embedding dump (our .npz or a reference pickle);
+        returns rows added. Load/verify errors propagate typed — the
+        build/append drivers decide whether to skip-and-count or fail."""
+        from dcr_tpu.search.embed import load_embeddings
+
+        features, keys = load_embeddings(path)
+        n = self.add(features, keys)
+        self._sources.append(str(path))
+        return n
+
+    def _flush_shard(self, take: int) -> None:
+        # consume rows from the FRONT of the buffer; the remainder stays as
+        # views, never re-concatenated — one big add() flushes its shards
+        # with linear copy traffic, not quadratic
+        feat_parts: list[np.ndarray] = []
+        key_parts: list[np.ndarray] = []
+        got = 0
+        while got < take and self._rows:
+            f, k = self._rows[0]
+            need = take - got
+            if len(f) <= need:
+                feat_parts.append(f)
+                key_parts.append(k)
+                got += len(f)
+                self._rows.pop(0)
+            else:
+                feat_parts.append(f[:need])
+                key_parts.append(k[:need])
+                self._rows[0] = (f[need:], k[need:])
+                got = take
+        feats = (feat_parts[0] if len(feat_parts) == 1
+                 else np.concatenate(feat_parts))
+        keys = (key_parts[0] if len(key_parts) == 1
+                else np.concatenate(key_parts))
+        take = got
+        buf = BytesIO()
+        np.savez(buf, features=feats, keys=keys)
+        blob = buf.getvalue()
+        name = f"shard_{len(self._shards):05d}.npz"
+        path = self.dir / name
+        tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
+        with tracing.span("search/ingest", shard=name, rows=int(take),
+                          bytes=len(blob)):
+            tmp.write_bytes(blob)
+            os.replace(tmp, path)
+        self._shards.append({"file": name, "sha256": _sha(blob),
+                             "count": int(take)})
+        self._total += take
+        tracing.registry().counter("search/ingest_rows_total").inc(take)
+        self._pending -= take
+
+    def finalize(self) -> Path:
+        """Flush the tail shard and commit the manifest (atomically, last)."""
+        while self._pending:
+            self._flush_shard(self.shard_rows)
+        doc = {
+            "version": STORE_VERSION,
+            "kind": STORE_KIND,
+            "created_at": time.time(),
+            "embed_dim": int(self.embed_dim or 0),
+            "shard_rows": self.shard_rows,
+            "normalized": self.normalize,
+            "total": self._total,
+            "shards": self._shards,
+            "sources": self._sources,
+        }
+        path = self.dir / MANIFEST_NAME
+        tmp = path.with_name(f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        tracing.event("search/store_finalized", shards=len(self._shards),
+                      rows=self._total)
+        tracing.registry().gauge("search/store_rows").set(self._total)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Manifest + reader: verify before load, quarantine on damage
+# ---------------------------------------------------------------------------
+
+def read_store_manifest(store_dir: Path, *, quarantine: bool = True) -> dict:
+    """Load + structurally verify ``store_manifest.json``. Raises
+    :class:`StoreError`; a corrupt (unparseable) manifest is additionally
+    quarantine-renamed so the next incarnation isn't poisoned by the same
+    bytes — unless ``quarantine=False`` (read-only inspection of a
+    possibly-shared store must not rename anything)."""
+    path = Path(store_dir) / MANIFEST_NAME
+    try:
+        raw = R.read_bytes_with_retry(path, name="store_manifest")
+    except FileNotFoundError:
+        raise StoreError(
+            f"{store_dir} has no {MANIFEST_NAME} — not an embedding store "
+            "(run `dcr-search build` first)") from None
+    except OSError as e:
+        raise StoreError(f"store manifest unreadable: {e!r}") from e
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        if doc.get("kind") != STORE_KIND:
+            raise ValueError(f"kind is {doc.get('kind')!r}, not {STORE_KIND}")
+        if not isinstance(doc.get("shards"), list):
+            raise ValueError("manifest missing shards list")
+        for field in ("embed_dim", "shard_rows", "total"):
+            if not isinstance(doc.get(field), int):
+                raise ValueError(f"manifest field {field!r} missing/not int")
+    except (UnicodeDecodeError, ValueError) as e:
+        dest = quarantine_rename(path) if quarantine else None
+        R.log_event("store_manifest_corrupt", error=repr(e), path=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter("search/store_manifest_corrupt").inc()
+        raise StoreError(
+            f"store manifest corrupt ({e}); quarantined — rebuild the "
+            "store") from e
+    return doc
+
+
+class EmbeddingStoreReader:
+    """Verify-before-load shard access with per-shard quarantine.
+
+    Construction reads ONLY the manifest (a million-row store opens in
+    milliseconds); shards stream through :meth:`iter_shards` so callers —
+    the query engine's segment builder, ``dcr-search verify``, the
+    copy-risk loader — control residency. ``quarantine=False`` makes
+    verification read-only (the CLI ``verify`` subcommand inspects a
+    possibly-shared store without renaming anything).
+    """
+
+    def __init__(self, store_dir: str | Path, *, quarantine: bool = True):
+        self.dir = Path(store_dir)
+        self.quarantine = bool(quarantine)
+        self.manifest = read_store_manifest(self.dir,
+                                            quarantine=self.quarantine)
+        self.embed_dim = int(self.manifest["embed_dim"])
+        self.normalized = bool(self.manifest.get("normalized", False))
+        self.shard_rows = int(self.manifest["shard_rows"])
+        self.total = int(self.manifest["total"])
+        self._load_seq = 0
+
+    def __len__(self) -> int:
+        return self.total
+
+    @property
+    def shards(self) -> list[dict]:
+        return list(self.manifest["shards"])
+
+    # -- verification --------------------------------------------------------
+
+    def _load_shard(self, shard: dict) -> Optional[tuple[np.ndarray, np.ndarray]]:
+        from dcr_tpu.utils import faults
+
+        path = self.dir / str(shard.get("file", ""))
+        try:
+            blob = R.read_bytes_with_retry(path, name="store_shard")
+        except (FileNotFoundError, OSError) as e:
+            self._quarantine(path, "store_shard_missing", repr(e),
+                             rename=False)
+            return None
+        seq = self._load_seq
+        self._load_seq += 1
+        if faults.fire("store_shard_corrupt", load=seq):
+            # deterministic CI poisoning: damage the blob in memory so the
+            # REAL verify/quarantine/degrade path runs end to end
+            mid = len(blob) // 2
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] \
+                if blob else b""
+        if _sha(blob) != shard.get("sha256"):
+            self._quarantine(path, "store_shard_corrupt", "sha256 mismatch")
+            return None
+        try:
+            with np.load(BytesIO(blob), allow_pickle=False) as z:
+                feats = np.asarray(z["features"], np.float32)
+                keys = np.asarray(z["keys"], dtype=str)
+        except Exception as e:
+            self._quarantine(path, "store_shard_corrupt",
+                             f"unreadable npz: {e!r}")
+            return None
+        n = feats.shape[0] if feats.ndim == 2 else -1
+        if not (feats.ndim == 2 and feats.shape[1] == self.embed_dim
+                and len(keys) == n == shard.get("count")):
+            self._quarantine(path, "store_shard_corrupt",
+                             f"shape/count mismatch: features "
+                             f"{feats.shape}, {len(keys)} keys, manifest "
+                             f"count {shard.get('count')}")
+            return None
+        if not np.isfinite(feats).all():
+            self._quarantine(path, "store_shard_corrupt",
+                             "non-finite features")
+            return None
+        return feats, keys
+
+    def _quarantine(self, path: Path, kind: str, detail: str,
+                    rename: bool = True) -> None:
+        dest = quarantine_rename(path) if rename and self.quarantine else None
+        R.log_event("store_shard_quarantined", kind=kind, detail=detail,
+                    shard=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter(f"search/{kind}").inc()
+
+    # -- serving -------------------------------------------------------------
+
+    def iter_shards(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
+        """Yield verified ``(features [n, D], keys [n])`` per surviving
+        shard, manifest order. Corrupt shards are quarantined + counted and
+        simply not yielded; zero survivors raises StoreError (a store that
+        can serve NOTHING must be loud, not an empty result set)."""
+        survivors = 0
+        for shard in self.manifest["shards"]:
+            arrays = self._load_shard(shard)
+            if arrays is None:
+                continue
+            survivors += 1
+            yield arrays
+        if self.manifest["shards"] and not survivors:
+            raise StoreError(
+                f"store {self.dir}: no shard survived verification "
+                f"({len(self.manifest['shards'])} listed)")
+
+    def load_all(self) -> tuple[np.ndarray, list[str]]:
+        """Concatenated ``(features, keys)`` of every surviving shard — the
+        small-store convenience path (tests, equality pins)."""
+        feats, keys = [], []
+        for f, k in self.iter_shards():
+            feats.append(f)
+            keys.extend(k.tolist())
+        if not feats:
+            return np.zeros((0, self.embed_dim), np.float32), []
+        return np.concatenate(feats), keys
+
+    def verify(self) -> dict:
+        """Walk every shard through the full verification path; returns
+        ``{shards, ok, corrupt, rows_ok, total}`` (``dcr-search verify``)."""
+        ok = corrupt = rows = 0
+        for shard in self.manifest["shards"]:
+            arrays = self._load_shard(shard)
+            if arrays is None:
+                corrupt += 1
+            else:
+                ok += 1
+                rows += arrays[0].shape[0]
+        return {"shards": len(self.manifest["shards"]), "ok": ok,
+                "corrupt": corrupt, "rows_ok": rows, "total": self.total}
+
+
+# ---------------------------------------------------------------------------
+# Build/append drivers (the CLI's workhorses)
+# ---------------------------------------------------------------------------
+
+def _dump_sources(sources: Sequence[str | Path]) -> Iterator[Path]:
+    """Resolve each source to an embedding dump file: a file passes
+    through; a directory resolves via find_embedding_file; a directory of
+    chunk directories (the reference's laion_folder layout) expands."""
+    from dcr_tpu.search.embed import find_embedding_file
+
+    for src in sources:
+        src = Path(src)
+        if src.is_file():
+            yield src
+            continue
+        direct = find_embedding_file(src)
+        if direct is not None:
+            yield direct
+            continue
+        for sub in sorted(p for p in src.iterdir() if p.is_dir()):
+            dump = find_embedding_file(sub)
+            if dump is not None:
+                yield dump
+
+
+def ingest_dumps(writer: EmbeddingStoreWriter,
+                 sources: Sequence[str | Path]) -> dict:
+    """Stream every resolvable dump under ``sources`` into ``writer`` and
+    finalize. A dump that fails to load/verify is counted + logged and
+    skipped (corrupt chunks are expected at corpus scale — same tolerance
+    as the brute-force search path, but never silent); the manifest commits
+    only once at the end. A run that ingested ZERO rows raises
+    :class:`StoreError` WITHOUT committing — exit-0 success over an empty
+    (or unchanged, for append) store would just defer the failure to the
+    first query, and a committed empty build would block the corrected
+    rebuild behind the clobber refusal."""
+    rows = dumps = skipped = 0
+    for dump in _dump_sources(sources):
+        try:
+            rows += writer.add_dump(dump)
+            dumps += 1
+        except Exception as e:  # corrupt chunks are expected at scale
+            skipped += 1
+            R.log_event("store_ingest_dump_failed", path=str(dump),
+                        error=repr(e))
+            tracing.registry().counter("search/ingest_dump_failed").inc()
+            log.warning("store ingest: skipping %s (%r)", dump, e)
+    if rows == 0:
+        raise StoreError(
+            f"ingested 0 rows from {[str(s) for s in sources]} "
+            f"({skipped} dump(s) failed, {dumps} readable) — "
+            "not committing a manifest")
+    manifest_path = writer.finalize()
+    return {"rows": rows, "dumps": dumps, "skipped": skipped,
+            "shards": len(writer._shards), "total": writer._total,
+            "manifest": str(manifest_path)}

@@ -342,7 +342,7 @@ class GenerationService:
         self._risk_status = "absent"
         self._risk_done = threading.Event()
         self._evidence = None
-        if cfg.risk.index_path:
+        if cfg.risk.index_path or cfg.risk.store_dir:
             self._risk_status = "loading"
             threading.Thread(target=self._load_risk_index, daemon=True,
                              name="risk-index-load").start()
@@ -715,12 +715,13 @@ class GenerationService:
         from dcr_tpu.obs.copyrisk import CopyRiskIndex, EvidenceRecorder
 
         cfg = self.cfg
+        source = cfg.risk.store_dir or cfg.risk.index_path
         try:
             with R.stage("risk_index_load"):
                 index = CopyRiskIndex.load(cfg.risk, batch=cfg.max_batch,
                                            warm_dir=cfg.warm.dir)
         except Exception as e:
-            R.log_event("risk_index_load_failed", path=cfg.risk.index_path,
+            R.log_event("risk_index_load_failed", path=source,
                         error=repr(e))
             R.bump_counter("copy_risk/index_load_failed")
             self._risk_status = "failed"
@@ -736,7 +737,7 @@ class GenerationService:
         self._risk_status = "ok"
         self._risk_done.set()
         log.info("serve: copy-risk index ok — %d train embeddings from %s "
-                 "(threshold %.3f%s)", len(index), cfg.risk.index_path,
+                 "(threshold %.3f%s)", len(index), source,
                  cfg.risk.threshold,
                  f", evidence -> {ev_dir}" if ev_dir else "")
 
@@ -805,8 +806,8 @@ class GenerationService:
         index = self._risk
         if index is None:
             raise RiskUnavailableError(
-                f"risk index is {self._risk_status} "
-                f"(index_path={self.cfg.risk.index_path!r})",
+                f"risk index is {self._risk_status} (source="
+                f"{(self.cfg.risk.store_dir or self.cfg.risk.index_path)!r})",
                 status=self._risk_status)
         image = decode_image_b64(body)
         with tracing.span("serve/risk_score", source="check", batch=1) as sp:

@@ -59,6 +59,22 @@ coordinated-recovery tests. Supported kinds and their hook points:
   producer re-encodes live (``latentcache/batch_recompute``). This is how
   CI proves a damaged latent cache can never crash a run or train on wrong
   latents. ``latent_cache_corrupt@load=0`` poisons the first shard.
+- ``search_dump_corrupt`` — embedding-dump load (search/embed.py), coord
+  ``load`` (per-process verified-dump read index): damages the just-read
+  dump bytes in memory so the sha256-sidecar verification fails exactly
+  like a torn write — the load raises a typed ``EmbeddingDumpError``, a
+  ``search/dump_corrupt`` counter bumps, and the calling layer (search
+  folder scan, copy-risk loader) quarantines the dump. This is how CI
+  proves a torn embedding dump is detected at load instead of producing a
+  wrong similarity table. ``search_dump_corrupt@load=0`` poisons the first
+  verified read.
+- ``store_shard_corrupt`` — embedding-store shard load (search/store.py),
+  coord ``load`` (per-reader shard read index): damages the just-read
+  shard bytes in memory so the sha verification fails like real bit rot —
+  the shard is quarantine-renamed, a ``search/store_shard_corrupt``
+  counter bumps, and the store serves the surviving rows. This is how CI
+  proves a damaged store can never crash a query or return scores from
+  corrupt rows. ``store_shard_corrupt@load=0`` poisons the first shard.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

@@ -690,6 +690,7 @@ def test_repo_registered_surfaces_match_expectations():
         "eval/clip_score": False,
         "risk/score": True,         # dcr-watch online copy-risk top-k
         "search/matmul": True,      # the LAION brute-force search kernel
+        "search/topk": True,        # dcr-store mesh-sharded store top-k
     }
 
 

@@ -1,0 +1,236 @@
+"""Similarity-search bench: brute-force folder scan vs store-backed
+sharded top-k (dcr-store, ISSUE 15).
+
+Builds a synthetic SSCD-width corpus (random unit-scale float32 rows split
+across N folder dumps — the reference's LAION-chunk layout), then measures
+the SAME query set through both paths:
+
+- **brute**: ``search_folders`` — the reference-equivalent per-folder scan:
+  every folder dump re-loaded from disk, device matmul per gen-chunk, host
+  ``argpartition`` + top-k merge per chunk. This is what every search pays
+  today, so disk re-reads are part of its honest cost;
+- **store**: ``dcr-search build`` once (banked separately as
+  ``build_seconds`` — ingestion is paid once per corpus, not per search),
+  then the mesh-sharded ``search/topk`` engine: fixed device segments,
+  on-device ``lax.top_k`` merge, [B, K] host traffic instead of [B, N].
+
+Gate (full mode): store-backed query throughput must reach
+``MIN_SEARCH_SPEEDUP`` (1.5x) over brute force, or exit 1. Both modes pin
+the store-backed results EXACTLY equal (scores and keys) to the brute
+force — "faster" provably isn't "different". Results bank as
+BENCH_SEARCH.json.
+
+``--smoke`` (CI): small corpus; validates the JSON schema + the
+exact-equality pin; the throughput gate is recorded but not enforced
+(shared CI runners don't gate perf — the banked full run does).
+
+Usage: python tools/bench_search.py [--smoke]
+Env knobs: BENCH_SEARCH_ROWS (default 16384; smoke 768),
+BENCH_SEARCH_FOLDERS (4; smoke 3), BENCH_SEARCH_QUERIES (64; smoke 16),
+BENCH_SEARCH_TOPK (4), BENCH_SEARCH_DIM (512; smoke 64),
+BENCH_SEARCH_REPEATS (3; smoke 1), BENCH_SEARCH_MIN (gate, default 1.5).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).resolve().parent.parent / "BENCH_SEARCH.json"
+
+#: ISSUE 15 acceptance floor: store-backed vs brute-force query throughput.
+MIN_SEARCH_SPEEDUP = 1.5
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name) or default)
+
+
+def build_corpus(root: Path, *, rows: int, folders: int, dim: int,
+                 seed: int = 0):
+    """Folder dumps (the brute path's input) + the query matrix."""
+    import numpy as np
+
+    from dcr_tpu.search.embed import save_embeddings
+
+    rng = np.random.default_rng(seed)
+    per = -(-rows // folders)
+    paths = []
+    total = 0
+    for i in range(folders):
+        n = min(per, rows - total)
+        total += n
+        folder = root / f"chunk_{i:03d}"
+        folder.mkdir(parents=True)
+        feats = rng.standard_normal((n, dim)).astype(np.float32)
+        save_embeddings(folder / "embedding.npz", feats,
+                        [f"chunk{i}_img{j}" for j in range(n)])
+        paths.append(folder)
+    return paths
+
+
+def run_brute(gen, gen_keys, folders, *, top_k: int, repeats: int) -> dict:
+    from dcr_tpu.search.search import search_folders
+
+    result = None
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        result = search_folders(gen, gen_keys, folders, top_k=top_k)
+        best = min(best, time.perf_counter() - t0)
+    return {"seconds": round(best, 4), "result": result}
+
+
+def run_store(gen, store_dir, *, top_k: int, query_batch: int,
+              repeats: int) -> dict:
+    from dcr_tpu.search.shardindex import open_engine
+
+    t0 = time.perf_counter()
+    engine = open_engine(store_dir, top_k=top_k, query_batch=query_batch)
+    ready_s = time.perf_counter() - t0
+    engine.query(gen[:1])          # warmup: shapes already compiled by build
+    best = float("inf")
+    scores = keys = None
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        scores, keys = engine.query(gen)
+        best = min(best, time.perf_counter() - t0)
+    return {"seconds": round(best, 4), "ready_seconds": round(ready_s, 4),
+            "segments": engine.num_segments, "resident": engine.resident,
+            "scores": scores, "keys": keys}
+
+
+def validate_result(doc: dict) -> list[str]:
+    """Schema problems with a BENCH_SEARCH document ([] = valid). Used by
+    the --smoke leg and tests/test_store.py."""
+    problems: list[str] = []
+
+    def need(obj, field, types, where):
+        v = obj.get(field)
+        if not isinstance(v, types) or isinstance(v, bool) and types != bool:
+            problems.append(f"{where}.{field}: missing/wrong type")
+            return None
+        return v
+
+    need(doc, "version", int, "$")
+    cfg = need(doc, "config", dict, "$") or {}
+    for f in ("corpus_rows", "folders", "queries", "top_k", "embed_dim",
+              "query_batch", "repeats"):
+        need(cfg, f, int, "$.config")
+    brute = need(doc, "brute", dict, "$") or {}
+    need(brute, "seconds", (int, float), "$.brute")
+    need(brute, "rows_per_s", (int, float), "$.brute")
+    store = need(doc, "store", dict, "$") or {}
+    for f in ("seconds", "rows_per_s", "build_seconds"):
+        need(store, f, (int, float), "$.store")
+    need(store, "segments", int, "$.store")
+    eq = need(doc, "equality", dict, "$") or {}
+    for f in ("scores_equal", "keys_equal"):
+        if not isinstance(eq.get(f), bool):
+            problems.append(f"$.equality.{f}: missing/not bool")
+    gate = need(doc, "gate", dict, "$") or {}
+    need(gate, "min_speedup", (int, float), "$.gate")
+    need(gate, "speedup", (int, float), "$.gate")
+    need(gate, "enforced", bool, "$.gate")
+    if not isinstance(gate.get("passed"), bool):
+        problems.append("$.gate.passed: missing/not bool")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+
+    import numpy as np
+
+    from dcr_tpu.search.store import EmbeddingStoreWriter, ingest_dumps
+
+    rows = _env_int("BENCH_SEARCH_ROWS", 768 if smoke else 16384)
+    folders_n = _env_int("BENCH_SEARCH_FOLDERS", 3 if smoke else 4)
+    queries = _env_int("BENCH_SEARCH_QUERIES", 16 if smoke else 64)
+    top_k = _env_int("BENCH_SEARCH_TOPK", 4)
+    dim = _env_int("BENCH_SEARCH_DIM", 64 if smoke else 512)
+    repeats = _env_int("BENCH_SEARCH_REPEATS", 1 if smoke else 3)
+    min_speedup = float(os.environ.get("BENCH_SEARCH_MIN")
+                        or MIN_SEARCH_SPEEDUP)
+    print(f"bench_search{' --smoke' if smoke else ''}: corpus {rows}x{dim} "
+          f"across {folders_n} folders, {queries} queries, top_k={top_k}")
+
+    rng = np.random.default_rng(1)
+    gen = rng.standard_normal((queries, dim)).astype(np.float32)
+    gen_keys = [f"g{i}" for i in range(queries)]
+
+    with tempfile.TemporaryDirectory(prefix="bench_search_") as td:
+        root = Path(td)
+        folders = build_corpus(root / "corpus", rows=rows,
+                               folders=folders_n, dim=dim)
+        brute = run_brute(gen, gen_keys, folders, top_k=top_k,
+                          repeats=repeats)
+        t0 = time.perf_counter()
+        report = ingest_dumps(
+            EmbeddingStoreWriter.create(root / "store", shard_rows=4096),
+            folders)
+        build_s = time.perf_counter() - t0
+        store = run_store(gen, root / "store", top_k=top_k,
+                          query_batch=max(queries, 1), repeats=repeats)
+
+        scores_equal = bool(np.array_equal(brute["result"]["scores"],
+                                           store["scores"]))
+        keys_equal = bool((brute["result"]["keys"] == store["keys"]).all())
+        speedup = brute["seconds"] / max(store["seconds"], 1e-9)
+        doc = {
+            "version": 1,
+            "config": {"corpus_rows": rows, "folders": folders_n,
+                       "queries": queries, "top_k": top_k, "embed_dim": dim,
+                       "query_batch": queries, "repeats": repeats,
+                       "ingested_rows": int(report["rows"])},
+            "brute": {
+                "seconds": brute["seconds"],
+                "rows_per_s": round(queries * rows / max(brute["seconds"],
+                                                         1e-9)),
+            },
+            "store": {
+                "build_seconds": round(build_s, 4),
+                "ready_seconds": store["ready_seconds"],
+                "seconds": store["seconds"],
+                "rows_per_s": round(queries * rows / max(store["seconds"],
+                                                         1e-9)),
+                "segments": int(store["segments"]),
+                "resident": bool(store["resident"]),
+            },
+            "equality": {"scores_equal": scores_equal,
+                         "keys_equal": keys_equal},
+            "gate": {"min_speedup": min_speedup,
+                     "speedup": round(speedup, 3),
+                     "enforced": not smoke,
+                     "passed": bool(speedup >= min_speedup)},
+        }
+
+    problems = validate_result(doc)
+    OUT.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    print(f"bench_search: brute {brute['seconds']}s vs store "
+          f"{store['seconds']}s -> speedup {doc['gate']['speedup']}x "
+          f"(build {doc['store']['build_seconds']}s, paid once) -> {OUT}")
+    if problems:
+        print("bench_search: SCHEMA problems:\n  " + "\n  ".join(problems))
+        return 1
+    if not (scores_equal and keys_equal):
+        print("bench_search: EQUALITY FAILED — store-backed results differ "
+              f"from brute force (scores_equal={scores_equal}, "
+              f"keys_equal={keys_equal})")
+        return 1
+    if not smoke and not doc["gate"]["passed"]:
+        print(f"bench_search: GATE FAILED — speedup "
+              f"{doc['gate']['speedup']}x < {min_speedup}x")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -382,6 +382,33 @@ def _build_risk_score() -> dict:
                                "batch": batch, "index_size": index_n})
 
 
+def _build_search_topk(normalize: bool = False) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import SearchConfig, ServeConfig
+    from dcr_tpu.obs.copyrisk import EMBED_DIM
+    from dcr_tpu.search.shardindex import make_topk
+
+    scfg = SearchConfig()
+    segment_rows = 4096                 # representative device segment
+    # the risk variant is the store-backed copy-risk scorer: cosine
+    # (queries normalized in-program) at the serve bucket batch; the
+    # default variant is the search path's raw-dot program at the
+    # SearchConfig query batch — exact-equality with the brute force
+    batch = ServeConfig().max_batch if normalize else scfg.query_batch
+    fn = make_topk(scfg.top_k, normalize)
+    feats = jax.ShapeDtypeStruct((segment_rows, EMBED_DIM), jnp.float32)
+    valid = jax.ShapeDtypeStruct((segment_rows,), jnp.bool_)
+    q = jax.ShapeDtypeStruct((batch, EMBED_DIM), jnp.float32)
+    return dict(fn=fn, args=(feats, valid, q),
+                static_config={"top_k": scfg.top_k,
+                               "segment_rows": segment_rows,
+                               "query_batch": batch,
+                               "embed_dim": EMBED_DIM,
+                               "normalize_queries": normalize})
+
+
 def _build_search_matmul() -> dict:
     import jax
     import jax.numpy as jnp
@@ -435,6 +462,12 @@ SURFACES: tuple[SurfaceSpec, ...] = (
                 _build_risk_score),
     SurfaceSpec("search/matmul@default", "search/matmul", "default",
                 _build_search_matmul),
+    # dcr-store: the mesh-sharded store-backed top-k engine — the search
+    # path's raw-dot program and the store-backed copy-risk cosine variant
+    SurfaceSpec("search/topk@default", "search/topk", "default",
+                _build_search_topk),
+    SurfaceSpec("search/topk@risk", "search/topk", "risk",
+                lambda: _build_search_topk(True)),
 )
 
 

@@ -21,6 +21,9 @@ validated against the checked-in ``tools/trace_schema.json``. The report:
   ``hbm_peak``/``hbm_delta`` attrs hot-region spans carry on backends with
   ``memory_stats()``, plus the compiled surfaces ranked by XLA temp bytes
   (``memwatch/surface_memory`` events);
+- search (dcr-store): store-backed top-k segment-scan throughput
+  (``search/topk`` spans), brute-force chunk time (``search/chunk``), and
+  store ingestion volume (``search/ingest``);
 - copy risk (dcr-watch): flagged-generation count, gen↔train similarity
   percentiles (from ``serve/risk_score`` / ``risk/score`` span ``sims``),
   the most-hit train keys, and a flagged-request timeline from
@@ -387,6 +390,53 @@ def copy_risk_summary(records: list[dict]) -> dict | None:
     }
 
 
+def search_summary(records: list[dict]) -> dict | None:
+    """The "Search" section (dcr-store): similarity-search time breakdown.
+
+    Built from three span families: ``search/topk`` (the store-backed
+    mesh-sharded query program — one span per segment scan, carrying
+    ``rows`` and ``batch``), ``search/chunk`` (the brute-force per-folder
+    matmul+host-merge path), and ``search/ingest`` (store shard writes).
+    None when nothing searched/ingested — other traces keep their shape.
+    """
+    topk = [r for r in records
+            if r["ph"] == "X" and r["name"] == "search/topk"]
+    chunk = [r for r in records
+             if r["ph"] == "X" and r["name"] == "search/chunk"]
+    ingest = [r for r in records
+              if r["ph"] == "X" and r["name"] == "search/ingest"]
+    if not topk and not chunk and not ingest:
+        return None
+    out: dict = {}
+    if topk:
+        durs = sorted(r["dur"] / 1e3 for r in topk)
+        rows = sum(int(r["args"].get("rows", 0)) for r in topk)
+        total_ms = sum(durs)
+        out["store_topk"] = {
+            "segment_scans": len(topk),
+            "rows_scanned": rows,
+            "total_ms": round(total_ms, 3),
+            "p50_ms": round(_percentile(durs, 50), 3),
+            "p99_ms": round(_percentile(durs, 99), 3),
+            "rows_per_s": round(rows / max(total_ms / 1e3, 1e-9)),
+        }
+    if chunk:
+        durs = sorted(r["dur"] / 1e3 for r in chunk)
+        out["brute_chunks"] = {
+            "chunks": len(chunk),
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50), 3),
+            "p99_ms": round(_percentile(durs, 99), 3),
+        }
+    if ingest:
+        out["ingest"] = {
+            "shards": len(ingest),
+            "rows": sum(int(r["args"].get("rows", 0)) for r in ingest),
+            "total_ms": round(sum(r["dur"] for r in ingest) / 1e3, 3),
+        }
+    return out
+
+
 def _interval_overlap_us(a: list[tuple[float, float]],
                          b: list[tuple[float, float]]) -> float:
     """Total pairwise intersection of two interval lists (start, end),
@@ -648,6 +698,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "serve_recompiles_per_bucket": recompiles,
         "compiles_per_incarnation": compiles_per_incarnation(records),
         "copy_risk": copy_risk_summary(records),
+        "search": search_summary(records),
         "fast_sampling": fast_sampling_summary(records),
         "pipeline": pipeline_summary(records),
         "memory": memory_summary(records),
@@ -777,6 +828,27 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         for s in mem["top_surfaces_by_temp_bytes"][:5]:
             lines.append(f"  surface {s['surface']:<40} temp "
                          f"{s['temp_bytes']} B  total {s['total_bytes']} B")
+    search = summary.get("search")
+    if search:
+        lines.append("\nsearch:")
+        topk = search.get("store_topk")
+        if topk:
+            lines.append(
+                f"  store top-k: {topk['segment_scans']} segment scan(s), "
+                f"{topk['rows_scanned']} rows in {topk['total_ms']} ms "
+                f"({topk['rows_per_s']} rows/s)  p50 {topk['p50_ms']} ms  "
+                f"p99 {topk['p99_ms']} ms")
+        brute = search.get("brute_chunks")
+        if brute:
+            lines.append(
+                f"  brute force: {brute['chunks']} chunk(s) in "
+                f"{brute['total_ms']} ms  p50 {brute['p50_ms']} ms  "
+                f"p99 {brute['p99_ms']} ms")
+        ing = search.get("ingest")
+        if ing:
+            lines.append(
+                f"  ingest: {ing['shards']} shard(s), {ing['rows']} rows in "
+                f"{ing['total_ms']} ms")
     risk = summary.get("copy_risk")
     if risk:
         lines.append(f"\ncopy risk: {risk['scored']} generation(s) scored, "
