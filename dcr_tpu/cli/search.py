@@ -12,6 +12,12 @@ Subcommands:
     verify    --store_dir=...            # read-only; exit 1 on corrupt shards
     query     --store_dir=... --gen_folder=... --out_path=... [--top_k=K]
               [--query_batch=B] [--segment_rows=R] [--warm_dir=...]
+              [--live=true]              # include the WAL live tail (dcr-live)
+    recover   --store_dir=...            # replay the WAL: truncate torn
+                                         # tails, reload acked rows, print
+                                         # the recovery report
+    compact   --store_dir=...            # recover, then fold the WAL into
+                                         # committed shards + new snapshot
 """
 
 from __future__ import annotations
@@ -25,8 +31,8 @@ from dcr_tpu.core.config import SearchConfig, parse_cli
 from dcr_tpu.search import embed as E
 from dcr_tpu.search import search as S
 
-USAGE = ("usage: dcr-search {download|embed|search|build|append|verify|query}"
-         " --key=value ...")
+USAGE = ("usage: dcr-search {download|embed|search|build|append|verify|query"
+         "|recover|compact} --key=value ...")
 
 
 def _store_sources(cfg: SearchConfig) -> list:
@@ -73,6 +79,22 @@ def _cmd_query(cfg: SearchConfig) -> None:
     print(f"search results -> {out}")
 
 
+def _cmd_recover(cfg: SearchConfig, compact: bool) -> None:
+    """Take the writer lease, replay the WAL (truncating torn tails), and
+    with ``compact`` fold the recovered tail into committed shards and
+    publish the next snapshot — the manual form of what a restarted
+    ingesting worker does on open."""
+    from dcr_tpu.search.livestore import LiveStore
+
+    if not cfg.store_dir:
+        raise SystemExit("recover/compact needs --store_dir=<dir>")
+    with LiveStore.open(cfg.store_dir) as live:
+        report = live.report()
+        if compact:
+            report["compaction"] = live.compact()
+    print(json.dumps(report, indent=1, sort_keys=True))
+
+
 def main(argv=None) -> None:
     from dcr_tpu.cli import setup_platform
 
@@ -111,6 +133,10 @@ def main(argv=None) -> None:
         _cmd_verify(cfg)
     elif command == "query":
         _cmd_query(cfg)
+    elif command == "recover":
+        _cmd_recover(cfg, compact=False)
+    elif command == "compact":
+        _cmd_recover(cfg, compact=True)
     else:
         raise SystemExit(f"unknown subcommand {command!r}")
 

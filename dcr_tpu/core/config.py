@@ -355,6 +355,28 @@ class RiskConfig:
 
 
 @dataclass
+class IngestConfig:
+    """dcr-live streaming provenance ingest (search/livestore.py + serve/
+    ingest.py): with ``enabled`` and ``risk.store_dir`` set, every scored
+    generation's SSCD embedding is enqueued on a bounded queue and appended
+    to a crash-safe WAL tier in front of the committed store; compaction
+    periodically folds the WAL into shards and publishes a new snapshot.
+    The response path only ever enqueues (never blocks) — a full queue
+    drops-and-counts (``dcr_ingest_dropped_total``)."""
+
+    enabled: bool = False
+    # response-path queue bound (rows). Overflow drops rows, never blocks.
+    queue_max: int = 1024
+    batch_rows: int = 16      # rows folded into one WAL record / fsync
+    seal_rows: int = 4096     # rows per WAL segment before it seals
+    # acked-but-uncompacted rows that trigger compaction into committed
+    # shards + a new snapshot. 0 = never auto-compact (WAL-only; recovery
+    # replays the whole tail).
+    compact_rows: int = 2048
+    lease_s: float = 10.0     # writer-lease TTL (stale-takeover horizon)
+
+
+@dataclass
 class OptimConfig:
     learning_rate: float = 5e-6
     adam_beta1: float = 0.9
@@ -518,6 +540,8 @@ class ServeConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     warm: WarmCacheConfig = field(default_factory=WarmCacheConfig)
     risk: RiskConfig = field(default_factory=RiskConfig)
+    # dcr-live: stream scored generations' embeddings into risk.store_dir
+    ingest: IngestConfig = field(default_factory=IngestConfig)
     # fast default bucket: with fast.enabled the default GenBucket carries
     # the reuse plan (per-request overrides can still request a dense or
     # differently-planned bucket within the compiled-bucket budget)
@@ -561,7 +585,31 @@ def validate_serve_config(cfg: ServeConfig) -> None:
                              " must be > 0 (an unbounded scrape turns a dead "
                              "worker into a hung /metrics)")
     validate_risk_config(cfg.risk)
+    validate_ingest_config(cfg)
     validate_fast_config(cfg.fast)
+
+
+def validate_ingest_config(cfg: ServeConfig) -> None:
+    i = cfg.ingest
+    if not i.enabled:
+        return
+    if not cfg.risk.store_dir:
+        raise ValueError(
+            "ingest.enabled requires risk.store_dir: live ingest appends to "
+            "the sharded embedding store the risk index scores against "
+            "(a dense risk.index_path dump has no append path)")
+    if i.queue_max < 1:
+        raise ValueError("ingest.queue_max must be >= 1")
+    if i.batch_rows < 1:
+        raise ValueError("ingest.batch_rows must be >= 1")
+    if i.seal_rows < 1:
+        raise ValueError("ingest.seal_rows must be >= 1")
+    if i.compact_rows < 0:
+        raise ValueError("ingest.compact_rows must be >= 0 (0 disables "
+                         "auto-compaction)")
+    if i.lease_s <= 0:
+        raise ValueError("ingest.lease_s must be > 0 (the stale-writer "
+                         "takeover horizon)")
 
 
 def validate_fast_config(f: FastSampleConfig) -> None:
@@ -698,6 +746,9 @@ class SearchConfig:
     top_k: int = 1               # nearest corpus keys kept per query
     query_batch: int = 64        # fixed compiled query-batch shape
     segment_rows: int = 0        # rows per device segment; 0 = auto
+    # dcr-live: query the committed snapshot PLUS the WAL live tail (rows
+    # acked by a streaming ingester but not yet compacted), merged
+    live: bool = False
     warm_dir: str = ""           # persistent executable cache (dcr-warm)
     logdir: str = ""             # trace.jsonl sink for search/* spans
 

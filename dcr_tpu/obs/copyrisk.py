@@ -299,6 +299,12 @@ class CopyRiskIndex:
         self._extract = None
         self._score = None
         self._engine = None           # ShardedTopK (store mode)
+        self._mesh = None
+        # live-tail provider (dcr-live): worker sets this to the ingest
+        # pump's ``tail(after_seq)`` so scoring covers acked-but-uncompacted
+        # rows; called with the engine snapshot's wal_through so committed
+        # + tail is one consistent corpus
+        self.live_tail = None
 
     def __len__(self) -> int:
         return self._store.total if self._store is not None \
@@ -374,6 +380,7 @@ class CopyRiskIndex:
             # hook (which scores on the primary only)
             mesh = pmesh.make_mesh(MeshConfig(data=1),
                                    devices=jax.devices()[:1])
+            self._mesh = mesh
             model, params = self._sscd_params()
             extractor = make_extractor(
                 lambda p, x: model.apply({"params": p}, x), params, mesh)
@@ -428,11 +435,66 @@ class CopyRiskIndex:
                      self.batch, self.top_k, res.source, scorer_src)
         return self
 
+    def refresh_store(self) -> bool:
+        """dcr-live: re-open the store against the newest snapshot and
+        rebuild the search engine, swapping it in atomically — in-flight
+        queries keep the engine (and therefore the snapshot) they started
+        with (reader isolation). Same segment geometry, batch and top_k as
+        the running engine, so the warm ``search/topk`` program is reused
+        with ZERO new compiles. Returns True when a newer snapshot was
+        picked up. A compaction racing the rebuild surfaces as the typed
+        retryable :class:`~dcr_tpu.search.store.StoreSnapshotChangedError`;
+        one retry lands on the newer snapshot."""
+        from dcr_tpu.search.shardindex import ShardedTopK
+        from dcr_tpu.search.store import (EmbeddingStoreReader,
+                                          StoreSnapshotChangedError)
+
+        if self._store is None:
+            return False
+        with self._lock:
+            if not self._built:
+                return False
+            old = self._engine
+            for attempt in (0, 1):
+                reader = EmbeddingStoreReader(self._store.dir)
+                if (reader.snapshot == self._store.snapshot
+                        and reader.total == self._store.total):
+                    return False
+                try:
+                    engine = ShardedTopK(
+                        reader, mesh=self._mesh, top_k=self.top_k,
+                        query_batch=self.batch,
+                        segment_rows=old.segment_rows,
+                        normalize_queries=True,
+                        normalize_rows=not reader.normalized,
+                        warm_dir=self.warm_dir).build()
+                    break
+                except StoreSnapshotChangedError as e:
+                    if attempt:
+                        raise
+                    log.info("copyrisk: %s — retrying against the newer "
+                             "snapshot", e)
+            self._engine = engine
+            self._store = reader
+            log.info("copyrisk: store refreshed — snapshot v%d, %d rows",
+                     reader.snapshot, reader.total)
+            tracing.event("risk/store_refreshed", snapshot=reader.snapshot,
+                          rows=reader.total)
+            return True
+
     # -- scoring -------------------------------------------------------------
 
     def score_batch(self, images: np.ndarray) -> list[RiskScore]:
         """Score up to ``batch`` generated images (float [n, H, W, 3] in
         [0, 1]); pads to the compiled batch shape, discards pad rows."""
+        return self.score_batch_with_features(images)[0]
+
+    def score_batch_with_features(
+            self, images: np.ndarray
+    ) -> tuple[list[RiskScore], np.ndarray]:
+        """:meth:`score_batch` plus the raw SSCD embeddings [n, 512] it
+        scored with — the live-ingest hook (dcr-live) streams these into
+        the store, so ingest costs no second extractor pass."""
         if not self._built:
             self.build()
         images = np.asarray(images)
@@ -440,7 +502,7 @@ class CopyRiskIndex:
             images = images[None]
         n = images.shape[0]
         if n == 0:
-            return []
+            return [], np.zeros((0, EMBED_DIM), np.float32)
         if n > self.batch:
             raise ValueError(
                 f"score_batch of {n} exceeds the compiled batch shape "
@@ -450,13 +512,26 @@ class CopyRiskIndex:
             prep = np.concatenate(
                 [prep, np.repeat(prep[-1:], self.batch - n, axis=0)])
         feats = self._extract(prep)
-        if self._engine is not None:
-            sims, key_rows = self._engine.query(np.asarray(feats)[:n])
-            return [RiskScore(max_sim=float(row_sims[0]),
-                              top_key=str(row_keys[0]),
-                              topk=[(str(k), float(s))
-                                    for s, k in zip(row_sims, row_keys)])
-                    for row_sims, row_keys in zip(sims, key_rows)]
+        feats_n = np.asarray(feats, np.float32)[:n]
+        engine = self._engine  # one engine per call: refresh swaps atomically
+        if engine is not None:
+            sims, key_rows = engine.query(feats_n)
+            tail_fn = self.live_tail
+            if tail_fn is not None:
+                from dcr_tpu.search.shardindex import merge_topk
+
+                tail_feats, tail_keys = tail_fn(engine.reader.wal_through)
+                if len(tail_feats):
+                    tail_sims, tail_out = engine.query_rows(
+                        feats_n, tail_feats, tail_keys)
+                    sims, key_rows = merge_topk(sims, key_rows,
+                                                tail_sims, tail_out)
+            scores = [RiskScore(max_sim=float(row_sims[0]),
+                                top_key=str(row_keys[0]),
+                                topk=[(str(k), float(s))
+                                      for s, k in zip(row_sims, row_keys)])
+                      for row_sims, row_keys in zip(sims, key_rows)]
+            return scores, feats_n
         sims, idx = self._score(self._feats_dev, feats)
         sims = np.asarray(sims)[:n]
         idx = np.asarray(idx)[:n]
@@ -466,7 +541,7 @@ class CopyRiskIndex:
                     for s, i in zip(row_sims, row_idx)]
             out.append(RiskScore(max_sim=topk[0][1], top_key=topk[0][0],
                                  topk=topk))
-        return out
+        return out, feats_n
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,686 @@
+"""dcr-live: crash-safe streaming provenance ingest (the WAL live tier).
+
+PR 15's store assumed one offline builder and a frozen manifest. The
+moment serve streams every generation's SSCD embedding in (ROADMAP item
+5 "Always-on provenance"), each failure mode the fleet already survives
+— SIGKILL, OOM exit 85, preemption 83, torn writes — becomes a
+store-corruption vector. This module makes live ingest crash-safe *by
+construction*:
+
+- **WAL appends** — every acked append is one sha256-framed record in a
+  write-ahead-log segment, fsynced before the ack. Recovery scans
+  segments front to back; the first frame that fails any check (magic,
+  header, payload sha, commit marker) marks the torn tail, which is
+  truncated, counted (``ingest/torn_total``), and never served. Unacked
+  rows may be lost; acked rows may not.
+- **Idempotent replay** — records carry a monotonic ``seq``; the
+  committed manifest records ``wal_through`` (the highest folded seq),
+  so a crash after the manifest commit but before WAL garbage-collection
+  can never double-ingest rows.
+- **Single writer** — the store's heartbeat writer lease
+  (:class:`~dcr_tpu.search.store.StoreWriterLease`, the fleet-lease
+  pattern) replaces PR 15's "one builder" assumption: a second ingester
+  gets a typed error, a crashed one's stale lease is taken over.
+- **Versioned snapshots** — compaction folds sealed WAL segments into
+  committed shards through the existing
+  :class:`~dcr_tpu.search.store.EmbeddingStoreWriter` append path, then
+  publishes ``store_manifest.v<N+1>.json`` and atomically flips
+  ``CURRENT``. The flip is the commit point: a crash mid-compaction
+  (``compact_crash``) leaves the previous snapshot serving and the WAL
+  intact.
+- **Live queries** — :func:`query_live` answers from the committed
+  snapshot through the device ``search/topk`` engine plus the WAL tail
+  scanned through the SAME compiled program
+  (:meth:`~dcr_tpu.search.shardindex.ShardedTopK.query_rows`), merged on
+  host — so a row scores bit-identically before and after compaction,
+  and a recovered store is query-equal (scores AND keys) to a post-hoc
+  rebuild over the acked set. That equivalence is the contract, enforced
+  by tests/test_livestore.py's SIGKILL chaos e2e and tools/bench_ingest.
+
+WAL record framing (little-endian)::
+
+    b"DCW1" | u32 header_len | header JSON | payload (npz) | b"DCC1"
+             header: {seq, rows, dim, payload_bytes, sha256, ts}
+             payload: np.savez(features float32 [n, D], keys [n] str)
+
+Deterministic fault kinds (utils/faults.py): ``wal_torn@append=N``
+(write a torn frame at the Nth append, no ack), ``ingest_crash@append=N``
+(SIGKILL mid-frame), ``compact_crash@seal=N`` (SIGKILL after the new
+manifest is written, before the ``CURRENT`` flip).
+
+Layout::
+
+    <dir>/wal/wal_00000000.log    # sealed + active WAL segments
+    <dir>/store_manifest.v<N>.json + CURRENT + writer.lease.json + shards
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import signal
+import struct
+import threading
+import time
+from io import BytesIO
+from pathlib import Path
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+from dcr_tpu.search.store import (CURRENT_NAME, DEFAULT_LEASE_S,
+                                  DEFAULT_SHARD_ROWS, EmbeddingStoreWriter,
+                                  MANIFEST_NAME, StoreError, StoreWriterLease,
+                                  normalize_rows, read_store_manifest,
+                                  snapshot_version)
+from dcr_tpu.utils import faults
+
+log = logging.getLogger("dcr_tpu")
+
+WAL_DIR = "wal"
+RECORD_MAGIC = b"DCW1"
+COMMIT_MAGIC = b"DCC1"
+_U32 = struct.Struct("<I")
+#: rows per WAL segment before the active segment seals
+DEFAULT_SEAL_ROWS = 4096
+
+
+def _segment_name(index: int) -> str:
+    return f"wal_{int(index):08d}.log"
+
+
+def _wal_dir(store_dir: str | Path) -> Path:
+    return Path(store_dir) / WAL_DIR
+
+
+def _encode_record(seq: int, features: np.ndarray, keys: np.ndarray) -> bytes:
+    buf = BytesIO()
+    np.savez(buf, features=features, keys=keys)
+    payload = buf.getvalue()
+    header = json.dumps(
+        {"seq": int(seq), "rows": int(features.shape[0]),
+         "dim": int(features.shape[1]), "payload_bytes": len(payload),
+         "sha256": hashlib.sha256(payload).hexdigest(), "ts": time.time()},
+        sort_keys=True).encode("utf-8")
+    return (RECORD_MAGIC + _U32.pack(len(header)) + header + payload
+            + COMMIT_MAGIC)
+
+
+def scan_wal_bytes(data: bytes) -> tuple[list[tuple[int, np.ndarray,
+                                                    np.ndarray]], int]:
+    """Parse committed records off the front of one WAL segment.
+
+    Returns ``(records, good_end)`` where ``records`` is
+    ``[(seq, features, keys), ...]`` and ``good_end`` is the byte offset
+    after the last fully-verified frame. ``good_end < len(data)`` means a
+    torn tail: every check a frame can fail — magic, header JSON, bounds,
+    payload sha256, commit marker, payload shape — lands here, because a
+    crashed writer can be interrupted between any two bytes."""
+    records: list[tuple[int, np.ndarray, np.ndarray]] = []
+    good_end = 0  # byte offset after the last fully-verified frame
+    off = 0
+    while off < len(data):
+        if data[off:off + 4] != RECORD_MAGIC:
+            break
+        off += 4
+        if off + _U32.size > len(data):
+            break
+        (hlen,) = _U32.unpack_from(data, off)
+        off += _U32.size
+        if off + hlen > len(data):
+            break
+        try:
+            header = json.loads(data[off:off + hlen].decode("utf-8"))
+            seq = int(header["seq"])
+            rows = int(header["rows"])
+            dim = int(header["dim"])
+            payload_bytes = int(header["payload_bytes"])
+            payload_sha = str(header["sha256"])
+        except (ValueError, KeyError, TypeError, UnicodeDecodeError):
+            break
+        off += hlen
+        if payload_bytes < 0 or off + payload_bytes + len(
+                COMMIT_MAGIC) > len(data):
+            break
+        payload = data[off:off + payload_bytes]
+        off += payload_bytes
+        if data[off:off + len(COMMIT_MAGIC)] != COMMIT_MAGIC:
+            break
+        off += len(COMMIT_MAGIC)
+        if hashlib.sha256(payload).hexdigest() != payload_sha:
+            break
+        try:
+            with np.load(BytesIO(payload), allow_pickle=False) as z:
+                feats = np.asarray(z["features"], np.float32)
+                keys = np.asarray(z["keys"], dtype=str)
+        except Exception:
+            break
+        if (feats.ndim != 2 or feats.shape != (rows, dim)
+                or len(keys) != rows or not np.isfinite(feats).all()):
+            break
+        records.append((seq, feats, keys))
+        good_end = off
+    return records, good_end
+
+
+def load_wal_tail(store_dir: str | Path, *, after_seq: Optional[int] = None,
+                  embed_dim: Optional[int] = None
+                  ) -> tuple[np.ndarray, np.ndarray, dict]:
+    """Read-only scan of the WAL tail: every committed record with
+    ``seq > after_seq`` across all segments (``after_seq`` defaults to the
+    committed manifest's ``wal_through``). Used by query paths that do NOT
+    hold the writer lease (``dcr-search query --live``, post-crash
+    inspection); never truncates or counts recovery — that is
+    :meth:`LiveStore.open`'s job. Returns ``(features [n, D], keys [n],
+    stats)`` with ``stats = {records, rows, torn_segments}``."""
+    store_dir = Path(store_dir)
+    if after_seq is None:
+        try:
+            after_seq = int(read_store_manifest(
+                store_dir, quarantine=False).get("wal_through", 0))
+        except StoreError:
+            after_seq = 0
+    feats_parts: list[np.ndarray] = []
+    key_parts: list[np.ndarray] = []
+    records = torn = 0
+    dim = embed_dim
+    wal = _wal_dir(store_dir)
+    for path in sorted(wal.glob("wal_*.log")) if wal.is_dir() else []:
+        data = path.read_bytes()
+        segment_records, good_end = scan_wal_bytes(data)
+        if good_end < len(data):
+            torn += 1
+        for seq, f, k in segment_records:
+            if seq <= after_seq:
+                continue
+            records += 1
+            dim = f.shape[1]
+            feats_parts.append(f)
+            key_parts.append(np.asarray(k, dtype=object))
+    if not feats_parts:
+        return (np.zeros((0, int(dim or 0)), np.float32),
+                np.zeros((0,), dtype=object),
+                {"records": 0, "rows": 0, "torn_segments": torn})
+    feats = np.concatenate(feats_parts)
+    keys = np.concatenate(key_parts)
+    return feats, keys, {"records": records, "rows": int(feats.shape[0]),
+                         "torn_segments": torn}
+
+
+class LiveStore:
+    """WAL-backed live tier in front of a committed embedding store.
+
+    Open with :meth:`open` (takes the writer lease, recovers the WAL);
+    :meth:`append` is a synchronous acked write; :meth:`compact` folds the
+    sealed WAL into committed shards and publishes the next snapshot;
+    :meth:`tail` serves the unfolded rows for live queries. One writer per
+    store — concurrent opens raise
+    :class:`~dcr_tpu.search.store.StoreLeaseHeldError`.
+    """
+
+    def __init__(self, store_dir: str | Path, lease: StoreWriterLease, *,
+                 embed_dim: Optional[int] = None,
+                 seal_rows: int = DEFAULT_SEAL_ROWS,
+                 store_shard_rows: int = DEFAULT_SHARD_ROWS):
+        self.dir = Path(store_dir)
+        self.seal_rows = max(1, int(seal_rows))
+        self.store_shard_rows = max(1, int(store_shard_rows))
+        self.embed_dim = embed_dim
+        self._lease = lease
+        self._mu = threading.Lock()
+        # unfolded rows, ascending seq: [(seq, features [n, D], keys [n])]
+        self._tail: list[tuple[int, np.ndarray, np.ndarray]] = []
+        self._tail_rows = 0
+        self._next_seq = 1
+        self._wal_through = 0
+        self._active_index = 0
+        self._active_rows = 0
+        self._active_file = None
+        self._append_count = 0
+        self._compact_count = 0
+        self.committed_total = 0
+        self.snapshot = 0
+        self.recovered_rows = 0
+        self.torn_segments = 0
+        self.closed = False
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def open(cls, store_dir: str | Path, *, embed_dim: Optional[int] = None,
+             seal_rows: int = DEFAULT_SEAL_ROWS,
+             store_shard_rows: int = DEFAULT_SHARD_ROWS,
+             lease_s: float = DEFAULT_LEASE_S, owner: str = "") -> "LiveStore":
+        """Acquire the writer lease and recover: truncate torn WAL tails
+        (counted, never served), reload acked-but-unfolded rows, GC
+        fully-folded segments, and resume the sequence counter."""
+        store_dir = Path(store_dir)
+        lease = StoreWriterLease(store_dir, owner=owner,
+                                 lease_s=lease_s).acquire()
+        try:
+            live = cls(store_dir, lease, embed_dim=embed_dim,
+                       seal_rows=seal_rows, store_shard_rows=store_shard_rows)
+            live._recover()
+            return live
+        except BaseException:
+            lease.release()
+            raise
+
+    def _recover(self) -> None:
+        _wal_dir(self.dir).mkdir(parents=True, exist_ok=True)
+        committed = None
+        if ((self.dir / MANIFEST_NAME).exists()
+                or (self.dir / CURRENT_NAME).exists()):
+            committed = read_store_manifest(self.dir)
+        if committed is not None:
+            dim = int(committed["embed_dim"])
+            if self.embed_dim is not None and int(self.embed_dim) != dim:
+                raise StoreError(
+                    f"live store width {self.embed_dim} != committed store "
+                    f"width {dim}")
+            self.embed_dim = dim
+            self.committed_total = int(committed["total"])
+            self.snapshot = int(committed.get("snapshot", 0))
+            self._wal_through = int(committed.get("wal_through", 0))
+            if bool(committed.get("normalized", False)):
+                raise StoreError(
+                    "live ingest requires a store built without ingest "
+                    "normalization (normalized=True folds rows it cannot "
+                    "reproduce from raw embeddings)")
+        max_seq = self._wal_through
+        max_index = -1
+        rows = torn = segments = 0
+        t0 = time.monotonic()
+        with tracing.span("ingest/recover", store=str(self.dir)) as sp:
+            for path in sorted(_wal_dir(self.dir).glob("wal_*.log")):
+                segments += 1
+                try:
+                    max_index = max(max_index,
+                                    int(path.stem.split("_", 1)[1]))
+                except ValueError:
+                    pass
+                data = path.read_bytes()
+                records, good_end = scan_wal_bytes(data)
+                if good_end < len(data):
+                    torn += 1
+                    lost = len(data) - good_end
+                    R.log_event("wal_torn_tail", segment=str(path),
+                                kept_records=len(records),
+                                truncated_bytes=lost)
+                    log.warning("livestore %s: torn WAL tail in %s — "
+                                "truncating %d byte(s) after %d committed "
+                                "record(s)", self.dir, path.name, lost,
+                                len(records))
+                    if good_end == 0:
+                        path.unlink()
+                    else:
+                        with open(path, "r+b") as f:
+                            f.truncate(good_end)
+                kept = [(seq, f, k) for seq, f, k in records
+                        if seq > self._wal_through]
+                if records and not kept and good_end == len(data):
+                    # every record already folded into the committed store:
+                    # the segment survived a crash between manifest commit
+                    # and WAL GC — finish the GC now (idempotent replay)
+                    path.unlink()
+                for seq, feats, keys in kept:
+                    max_seq = max(max_seq, seq)
+                    if self.embed_dim is None:
+                        self.embed_dim = int(feats.shape[1])
+                    if int(feats.shape[1]) != int(self.embed_dim):
+                        raise StoreError(
+                            f"WAL record width {feats.shape[1]} != store "
+                            f"width {self.embed_dim}")
+                    self._tail.append(
+                        (seq, feats, np.asarray(keys, dtype=object)))
+                    rows += feats.shape[0]
+                if records:
+                    max_seq = max(max_seq, max(seq for seq, _, _ in records))
+            sp.attrs.update(segments=segments, rows=rows, torn=torn,
+                            wal_through=self._wal_through,
+                            ms=round(1e3 * (time.monotonic() - t0), 3))
+        self._tail.sort(key=lambda r: r[0])
+        self._tail_rows = rows
+        self._next_seq = max_seq + 1
+        self._active_index = max_index + 1
+        self.recovered_rows = rows
+        self.torn_segments = torn
+        reg = tracing.registry()
+        if rows:
+            reg.counter("ingest/recovered_total").inc(rows)
+        if torn:
+            reg.counter("ingest/torn_total").inc(torn)
+        reg.gauge("store/rows_total").set(self.total_rows)
+        if rows or torn:
+            tracing.event("ingest/recovered", rows=rows, torn=torn,
+                          segments=segments, next_seq=self._next_seq)
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def tail_rows(self) -> int:
+        """Unpruned in-memory tail rows (may include already-folded rows
+        kept alive for readers still on the previous snapshot)."""
+        return self._tail_rows
+
+    @property
+    def total_rows(self) -> int:
+        """Committed rows + unfolded live rows — the queryable corpus."""
+        unfolded = sum(f.shape[0] for seq, f, _ in self._tail
+                       if seq > self._wal_through)
+        return self.committed_total + unfolded
+
+    @property
+    def wal_through(self) -> int:
+        return self._wal_through
+
+    @property
+    def next_seq(self) -> int:
+        return self._next_seq
+
+    def report(self) -> dict:
+        return {"store": str(self.dir), "snapshot": self.snapshot,
+                "committed_rows": self.committed_total,
+                "tail_rows": self.tail_rows, "total_rows": self.total_rows,
+                "recovered_rows": self.recovered_rows,
+                "torn_segments": self.torn_segments,
+                "wal_through": self._wal_through,
+                "next_seq": self._next_seq}
+
+    # -- append (the acked write path) ---------------------------------------
+
+    def _open_active(self):
+        if self._active_file is None:
+            path = _wal_dir(self.dir) / _segment_name(self._active_index)
+            self._active_file = open(path, "ab")
+        return self._active_file
+
+    def _roll(self) -> None:
+        if self._active_file is not None:
+            self._active_file.close()
+            self._active_file = None
+        self._active_index += 1
+        self._active_rows = 0
+
+    def append(self, features: np.ndarray, keys: Sequence[str]) -> int:
+        """Durably append one batch of rows; returns the record's ``seq``
+        once it is fsynced (the ack). Validation mirrors the committed
+        writer's so a bad batch is rejected BEFORE any bytes land."""
+        if self.closed:
+            raise StoreError(f"live store {self.dir} is closed")
+        features = np.asarray(features, np.float32)
+        if features.ndim != 2:
+            raise StoreError(
+                f"features must be [N, D], got shape {features.shape}")
+        if len(keys) != features.shape[0]:
+            raise StoreError(
+                f"{features.shape[0]} features but {len(keys)} keys — "
+                "torn input")
+        if features.shape[0] == 0:
+            raise StoreError("empty append")
+        if self.embed_dim is None:
+            self.embed_dim = int(features.shape[1])
+        if features.shape[1] != self.embed_dim:
+            raise StoreError(
+                f"embedding width {features.shape[1]} != store width "
+                f"{self.embed_dim}")
+        if not np.isfinite(features).all():
+            raise StoreError("input features contain non-finite values")
+        keys_arr = np.asarray([str(k) for k in keys], dtype=str)
+        n = int(features.shape[0])
+        with self._mu:
+            ac = self._append_count
+            self._append_count += 1
+            seq = self._next_seq
+            blob = _encode_record(seq, features, keys_arr)
+            f = self._open_active()
+            with tracing.span("ingest/append", seq=seq, rows=n,
+                              bytes=len(blob), segment=self._active_index):
+                if faults.fire("wal_torn", append=ac):
+                    # a torn frame exactly as a crash mid-write leaves it:
+                    # partial payload, no commit marker, never acked; the
+                    # active segment is abandoned so later appends stay
+                    # recoverable behind the torn tail
+                    f.write(blob[:max(8, len(blob) // 2)])
+                    f.flush()
+                    os.fsync(f.fileno())
+                    self._roll()
+                    raise StoreError(
+                        f"injected wal_torn fault at append {ac} — torn "
+                        "frame written, record not acked")
+                if faults.fire("ingest_crash", append=ac):
+                    f.write(blob[:max(8, len(blob) // 2)])
+                    f.flush()
+                    os.fsync(f.fileno())
+                    os.kill(os.getpid(), signal.SIGKILL)
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            self._next_seq = seq + 1
+            self._tail.append((seq, features, np.asarray(keys_arr,
+                                                         dtype=object)))
+            self._tail_rows += n
+            self._active_rows += n
+            reg = tracing.registry()
+            reg.counter("ingest/acked_total").inc(n)
+            reg.gauge("store/rows_total").set(self.total_rows)
+            if self._active_rows >= self.seal_rows:
+                self._roll()
+        return seq
+
+    # -- compaction (WAL -> committed shards -> next snapshot) ---------------
+
+    def compact(self, *, prune: bool = True) -> dict:
+        """Fold every sealed WAL row into committed shards via the store's
+        append path, publish snapshot v+1 (manifest file, then the atomic
+        ``CURRENT`` flip — the commit point), then GC the folded segments.
+        A crash anywhere before the flip leaves the previous snapshot
+        serving and the WAL replayable; a crash after it is just a
+        not-yet-GC'd WAL whose rows ``wal_through`` already excludes.
+
+        ``prune=False`` keeps folded rows in the in-memory tail so readers
+        still paired with the previous snapshot keep a complete view; the
+        caller prunes (:meth:`prune`) after refreshing its engines."""
+        if self.closed:
+            raise StoreError(f"live store {self.dir} is closed")
+        with self._mu:
+            if self._active_rows:
+                self._roll()
+            elif self._active_file is not None:
+                self._active_file.close()
+                self._active_file = None
+            cc = self._compact_count
+            self._compact_count += 1
+            folds = [(seq, f, k) for seq, f, k in self._tail
+                     if seq > self._wal_through]
+            if not folds:
+                return {"folded_rows": 0, "records": 0,
+                        "snapshot": self.snapshot}
+            folded_files = sorted(p for p in _wal_dir(self.dir).glob(
+                "wal_*.log") if p.name != _segment_name(self._active_index))
+            rows = sum(f.shape[0] for _, f, _ in folds)
+            last_seq = folds[-1][0]
+            t0 = time.monotonic()
+            with tracing.span("ingest/compact", seal=cc, rows=rows,
+                              records=len(folds),
+                              segments=len(folded_files)) as sp:
+                if ((self.dir / MANIFEST_NAME).exists()
+                        or (self.dir / CURRENT_NAME).exists()):
+                    writer = EmbeddingStoreWriter.append(self.dir,
+                                                         lease=self._lease)
+                else:
+                    writer = EmbeddingStoreWriter(
+                        self.dir, embed_dim=self.embed_dim,
+                        shard_rows=self.store_shard_rows, lease=self._lease)
+                writer.mark_live()
+                for _, feats, keys in folds:
+                    writer.add(feats, [str(k) for k in keys])
+                writer.mark_wal_through(last_seq)
+
+                def pre_current():
+                    # deterministic chaos: die after the new manifest is on
+                    # disk but before the CURRENT flip — the previous
+                    # snapshot must keep serving
+                    if faults.fire("compact_crash", seal=cc):
+                        os.kill(os.getpid(), signal.SIGKILL)
+
+                manifest = writer.finalize(_pre_current=pre_current)
+                self.committed_total = writer._total
+                self._wal_through = last_seq
+                self.snapshot = snapshot_version(self.dir)
+                for path in folded_files:
+                    try:
+                        path.unlink()
+                    except OSError:
+                        pass
+                sp.attrs.update(snapshot=self.snapshot,
+                                ms=round(1e3 * (time.monotonic() - t0), 3))
+            tracing.event("ingest/compacted", rows=rows, records=len(folds),
+                          snapshot=self.snapshot, wal_through=last_seq)
+            tracing.registry().gauge("store/rows_total").set(self.total_rows)
+            if prune:
+                self._prune_locked(last_seq)
+            return {"folded_rows": rows, "records": len(folds),
+                    "snapshot": self.snapshot, "wal_through": last_seq,
+                    "manifest": str(manifest),
+                    "wal_segments_deleted": len(folded_files)}
+
+    def _prune_locked(self, through_seq: int) -> None:
+        kept = [(seq, f, k) for seq, f, k in self._tail if seq > through_seq]
+        self._tail = kept
+        self._tail_rows = sum(f.shape[0] for _, f, _ in kept)
+
+    def prune(self, through_seq: Optional[int] = None) -> None:
+        """Drop folded rows from the in-memory tail once no reader needs
+        the previous snapshot (see :meth:`compact` ``prune=False``)."""
+        with self._mu:
+            self._prune_locked(self._wal_through if through_seq is None
+                               else int(through_seq))
+
+    # -- live reads ----------------------------------------------------------
+
+    def tail(self, after_seq: Optional[int] = None
+             ) -> tuple[np.ndarray, np.ndarray]:
+        """The acked rows newer than ``after_seq`` (default: this writer's
+        ``wal_through``) as ``(features [n, D], keys [n])``. A reader
+        paired with snapshot v passes v's ``wal_through`` so the committed
+        + tail union is exactly one consistent corpus — never a row twice,
+        never a row missing."""
+        after = self._wal_through if after_seq is None else int(after_seq)
+        with self._mu:
+            parts = [(f, k) for seq, f, k in self._tail if seq > after]
+        if not parts:
+            return (np.zeros((0, int(self.embed_dim or 0)), np.float32),
+                    np.zeros((0,), dtype=object))
+        return (np.concatenate([f for f, _ in parts]),
+                np.concatenate([k for _, k in parts]))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def close(self) -> None:
+        """Flush + close the active segment and release the writer lease.
+        Never deletes WAL rows — close is not compaction."""
+        if self.closed:
+            return
+        self.closed = True
+        with self._mu:
+            if self._active_file is not None:
+                self._active_file.flush()
+                os.fsync(self._active_file.fileno())
+                self._active_file.close()
+                self._active_file = None
+        self._lease.release()
+
+    def __enter__(self) -> "LiveStore":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Live queries: committed snapshot (device engine) + WAL tail, merged
+# ---------------------------------------------------------------------------
+
+def _host_topk(q: np.ndarray, feats: np.ndarray, keys: np.ndarray, *,
+               top_k: int, normalize_queries: bool,
+               normalize_tail_rows: bool) -> tuple[np.ndarray, np.ndarray]:
+    """Brute-force top-k over the tail alone (no committed snapshot yet):
+    the ``search_folders`` idiom — device matmul through the registered
+    ``search/matmul`` surface, host ``argpartition``. Normalization runs
+    on host here (there is no committed device program to stay bit-equal
+    to)."""
+    import jax
+
+    from dcr_tpu.search.search import make_search_matmul
+
+    if normalize_tail_rows:
+        feats = normalize_rows(feats)
+    if normalize_queries:
+        q = normalize_rows(q)
+    sims = np.asarray(jax.device_get(make_search_matmul()(q, feats)))
+    k = min(top_k, sims.shape[1])
+    top_idx = np.argpartition(-sims, k - 1, axis=1)[:, :k]
+    top_scores = np.take_along_axis(sims, top_idx, axis=1)
+    order = np.argsort(-top_scores, axis=1, kind="stable")
+    top_idx = np.take_along_axis(top_idx, order, axis=1)
+    top_scores = np.take_along_axis(top_scores, order, axis=1)
+    out_keys = np.asarray(keys, dtype=object)[top_idx]
+    if k < top_k:
+        pad = top_k - k
+        top_scores = np.pad(top_scores, ((0, 0), (0, pad)),
+                            constant_values=-np.inf)
+        out_keys = np.concatenate(
+            [out_keys, np.full((out_keys.shape[0], pad), "", dtype=object)],
+            axis=1)
+    return top_scores.astype(np.float32), out_keys
+
+
+def query_live(store_dir: str | Path, queries: np.ndarray, *, top_k: int = 1,
+               mesh=None, query_batch: int = 64, segment_rows: int = 0,
+               normalize_queries: bool = False, normalize_rows: bool = False,
+               warm_dir: str = "", engine=None,
+               tail: Optional[tuple[np.ndarray, np.ndarray]] = None
+               ) -> tuple[np.ndarray, np.ndarray]:
+    """Top-k against the LIVE corpus: the committed snapshot through the
+    device ``search/topk`` engine plus the WAL tail through the same
+    compiled program, merged on host (the cross-segment merge). Pass
+    ``engine`` to reuse a built engine (serve) and ``tail`` to serve an
+    in-memory tail (the ingesting worker); otherwise both come from disk —
+    the tail read-only, paired with the engine snapshot's ``wal_through``
+    so no row is seen twice or missed."""
+    from dcr_tpu.search.shardindex import merge_topk, open_engine
+
+    q = np.asarray(queries, np.float32)
+    store_dir = Path(store_dir)
+    committed = ((store_dir / MANIFEST_NAME).exists()
+                 or (store_dir / CURRENT_NAME).exists())
+    if engine is None and committed:
+        engine = open_engine(
+            store_dir, mesh=mesh, top_k=top_k, query_batch=query_batch,
+            segment_rows=segment_rows, normalize_queries=normalize_queries,
+            normalize_rows=normalize_rows, warm_dir=warm_dir)
+    after = engine.reader.wal_through if engine is not None else 0
+    if tail is None:
+        tail_feats, tail_keys, _ = load_wal_tail(
+            store_dir, after_seq=after,
+            embed_dim=engine.reader.embed_dim if engine is not None else None)
+    else:
+        tail_feats, tail_keys = tail
+    if engine is None and not len(tail_feats):
+        raise StoreError(
+            f"{store_dir} has neither a committed snapshot nor WAL rows — "
+            "nothing to query")
+    if engine is None:
+        return _host_topk(q, tail_feats, tail_keys, top_k=top_k,
+                          normalize_queries=normalize_queries,
+                          normalize_tail_rows=normalize_rows)
+    scores, keys = engine.query(q)
+    if len(tail_feats):
+        tail_scores, tail_out = engine.query_rows(q, tail_feats, tail_keys)
+        scores, keys = merge_topk(scores, keys, tail_scores, tail_out)
+    return scores, keys

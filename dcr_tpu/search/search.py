@@ -206,7 +206,19 @@ def run_search(cfg: SearchConfig, *,
             f"no embedding dump under {cfg.gen_folder}; run search.embed first")
     gen_features, gen_keys = load_embeddings(gen_emb)
     top_k = max(top_k, cfg.top_k)
-    if cfg.store_dir:
+    if cfg.store_dir and cfg.live:
+        # dcr-live: committed snapshot + WAL tail, merged (livestore.py)
+        from dcr_tpu.parallel import mesh as pmesh
+        from dcr_tpu.search.livestore import query_live
+
+        scores, keys = query_live(
+            cfg.store_dir, np.asarray(gen_features, np.float32),
+            top_k=top_k, mesh=pmesh.make_mesh(cfg.mesh),
+            query_batch=cfg.query_batch, segment_rows=cfg.segment_rows,
+            warm_dir=cfg.warm_dir)
+        result = {"scores": scores, "keys": keys,
+                  "gen_images": np.asarray(list(gen_keys), dtype=object)}
+    elif cfg.store_dir:
         from dcr_tpu.parallel import mesh as pmesh
 
         result = search_store(gen_features, gen_keys, cfg.store_dir,

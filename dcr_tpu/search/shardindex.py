@@ -277,11 +277,21 @@ class ShardedTopK:
         reg = tracing.registry()
         reg.counter("search/query_total").inc()
         reg.counter("search/query_rows_total").inc(n)
-        # all query chunks padded + device-put upfront (each is B x D,
-        # tiny), then segments stream OUTERMOST: a host-streamed corpus is
-        # uploaded once per query, not once per chunk
+        chunks = self._chunked_queries(q)
+        segments = (self._dev_segments if self.resident
+                    else map(self._put_segment, self._segments))
+        for si, seg in enumerate(segments):
+            self._scan_segment(si, seg, chunks, out_scores, out_keys)
+        return out_scores, out_keys
+
+    def _chunked_queries(self, q: np.ndarray) -> list[tuple[int, int, object]]:
+        """All query chunks padded + device-put upfront (each is B x D,
+        tiny), so segments can stream OUTERMOST: a host-streamed corpus is
+        uploaded once per query, not once per chunk."""
+        import jax
+
         chunks: list[tuple[int, int, object]] = []
-        for start in range(0, n, self.query_batch):
+        for start in range(0, q.shape[0], self.query_batch):
             chunk = q[start:start + self.query_batch]
             m = chunk.shape[0]
             if m < self.query_batch:
@@ -290,22 +300,69 @@ class ShardedTopK:
                                       axis=0)])
             chunks.append((start, m,
                            jax.device_put(chunk, self._q_sharding)))
-        segments = (self._dev_segments if self.resident
-                    else map(self._put_segment, self._segments))
-        for si, (feats, valid, keys, n_rows) in enumerate(segments):
-            for start, m, chunk_dev in chunks:
-                with tracing.span("search/topk", segment=si,
-                                  rows=int(n_rows), batch=m,
-                                  index_size=self.reader.total):
-                    scores, idx = self._fn(feats, valid, chunk_dev)
-                    scores = np.asarray(scores)[:m]
-                    idx = np.asarray(idx)[:m]
-                reg.counter("search/segments_scanned_total").inc()
-                # pad hits (score -inf) keep key "" — invisible post-merge
-                seg_keys = np.where(np.isneginf(scores), "", keys[idx])
-                sl = slice(start, start + m)
-                out_scores[sl], out_keys[sl] = merge_topk(
-                    out_scores[sl], out_keys[sl], scores, seg_keys)
+        return chunks
+
+    def _scan_segment(self, si: int, seg, chunks, out_scores: np.ndarray,
+                      out_keys: np.ndarray) -> None:
+        """Run every query chunk against one placed segment and fold the
+        [B, K] tables into the running answer in place."""
+        reg = tracing.registry()
+        feats, valid, keys, n_rows = seg
+        for start, m, chunk_dev in chunks:
+            with tracing.span("search/topk", segment=si,
+                              rows=int(n_rows), batch=m,
+                              index_size=self.reader.total):
+                scores, idx = self._fn(feats, valid, chunk_dev)
+                scores = np.asarray(scores)[:m]
+                idx = np.asarray(idx)[:m]
+            reg.counter("search/segments_scanned_total").inc()
+            # pad hits (score -inf) keep key "" — invisible post-merge
+            seg_keys = np.where(np.isneginf(scores), "", keys[idx])
+            sl = slice(start, start + m)
+            out_scores[sl], out_keys[sl] = merge_topk(
+                out_scores[sl], out_keys[sl], scores, seg_keys)
+
+    def query_rows(self, q: np.ndarray, feats: np.ndarray,
+                   keys: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
+        """Top-k of ``q`` against AD-HOC rows (the live WAL tail) through
+        the SAME compiled ``search/topk`` program the committed segments
+        run, so a row scores bit-identically whether it is still in the
+        tail or already compacted into a shard — the live tier's
+        crash-equivalence pin rests on exactly this. Rows follow the
+        engine's store conventions (normalization, ``segment_rows``
+        padding); callers merge the result with :meth:`query` via
+        :func:`merge_topk`."""
+        if not self._built:
+            self.build()
+        q = np.asarray(q, np.float32)
+        if q.ndim != 2 or q.shape[1] != self.reader.embed_dim:
+            raise ValueError(
+                f"queries must be [n, {self.reader.embed_dim}], got "
+                f"{q.shape}")
+        feats = np.asarray(feats, np.float32)
+        keys_arr = np.asarray(keys, dtype=object)
+        if feats.ndim != 2 or feats.shape[1] != self.reader.embed_dim:
+            raise ValueError(
+                f"tail rows must be [n, {self.reader.embed_dim}], got "
+                f"{feats.shape}")
+        if len(keys_arr) != feats.shape[0]:
+            raise ValueError(f"{feats.shape[0]} tail rows but "
+                             f"{len(keys_arr)} keys")
+        n = q.shape[0]
+        out_scores = np.full((n, self.top_k), -np.inf, np.float32)
+        out_keys = np.full((n, self.top_k), "", dtype=object)
+        if n == 0 or feats.shape[0] == 0:
+            return out_scores, out_keys
+        if self._normalize_rows:
+            feats = normalize_rows(feats)
+        chunks = self._chunked_queries(q)
+        dim = self.reader.embed_dim
+        for start in range(0, feats.shape[0], self.segment_rows):
+            seg = self._put_segment(self._pad_segment(
+                feats[start:start + self.segment_rows],
+                keys_arr[start:start + self.segment_rows], dim))
+            self._scan_segment(self.num_segments + start // self.segment_rows,
+                               seg, chunks, out_scores, out_keys)
         return out_scores, out_keys
 
 

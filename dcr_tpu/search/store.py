@@ -26,9 +26,32 @@ Verification discipline (the warmcache/latent-cache/copyrisk contract):
   committed manifest are immutable: ``append`` only adds shards and
   re-commits the manifest.
 
+Live-tier extensions (dcr-live, ISSUE 16):
+
+- **writer lease** — every :class:`EmbeddingStoreWriter` holds the store's
+  single-writer heartbeat lease (:class:`StoreWriterLease`, the fleet
+  worker-lease pattern) while it runs, so two concurrent builds/appends on
+  one directory get a typed :class:`StoreLeaseHeldError` instead of
+  silently interleaving shards; a stale lease (crashed writer) is taken
+  over, counted, and logged;
+- **versioned snapshots** — a live store commits
+  ``store_manifest.v<N>.json`` files plus an atomically-renamed ``CURRENT``
+  pointer. Readers resolve ``CURRENT`` first and fall back to the legacy
+  single ``store_manifest.json`` (snapshot 0), so every pre-live store
+  keeps working unchanged; a crash between manifest write and the
+  ``CURRENT`` flip leaves the previous snapshot serving;
+- **snapshot-change detection** — :class:`EmbeddingStoreReader` records
+  its snapshot at open and re-checks it before every shard read:
+  a manifest version that moved mid-iteration raises the typed, retryable
+  :class:`StoreSnapshotChangedError` instead of mixing rows from two
+  snapshots.
+
 Layout::
 
     <dir>/store_manifest.json     # kind/version/embed_dim + per-shard shas
+    <dir>/store_manifest.v2.json  # live tier: versioned snapshots ...
+    <dir>/CURRENT                 # ... resolved via this atomic pointer
+    <dir>/writer.lease.json       # single-writer heartbeat lease
     <dir>/shard_00000.npz         # features float32 [n, D], keys [n] str
 """
 
@@ -38,10 +61,12 @@ import hashlib
 import json
 import logging
 import os
+import re
+import threading
 import time
 from io import BytesIO
 from pathlib import Path
-from typing import Iterator, Optional, Sequence
+from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
@@ -54,9 +79,21 @@ log = logging.getLogger("dcr_tpu")
 STORE_VERSION = 1
 STORE_KIND = "dcr_embedding_store"
 MANIFEST_NAME = "store_manifest.json"
+#: atomically-renamed pointer naming the live snapshot's manifest file
+CURRENT_NAME = "CURRENT"
+#: single-writer heartbeat lease file (StoreWriterLease)
+LEASE_NAME = "writer.lease.json"
+#: default writer-lease duration; a writer silent for this long is dead
+DEFAULT_LEASE_S = 10.0
 #: rows per shard file — the ingest/IO unit, NOT the query unit (the query
 #: engine regroups shards into fixed device segments)
 DEFAULT_SHARD_ROWS = 4096
+
+_VERSIONED_RE = re.compile(r"^store_manifest\.v(\d+)\.json$")
+
+
+def versioned_manifest_name(snapshot: int) -> str:
+    return f"store_manifest.v{int(snapshot)}.json"
 
 
 class StoreError(RuntimeError):
@@ -66,6 +103,21 @@ class StoreError(RuntimeError):
     degrade (copy-risk scoring disabled)."""
 
 
+class StoreLeaseHeldError(StoreError):
+    """Typed: another live writer holds this store's single-writer lease.
+    Concurrent builds/appends on one directory would silently interleave
+    shard numbering — the second writer must wait (or the holder must die
+    and its lease expire) rather than corrupt the store."""
+
+
+class StoreSnapshotChangedError(StoreError):
+    """Typed + retryable: the store's snapshot (``CURRENT``) moved while a
+    reader was mid-iteration. Serving on would mix rows from two snapshots;
+    the caller re-opens the reader against the new snapshot and retries."""
+
+    retryable = True
+
+
 def _sha(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
@@ -73,6 +125,141 @@ def _sha(data: bytes) -> str:
 def normalize_rows(features: np.ndarray) -> np.ndarray:
     norms = np.linalg.norm(features, axis=-1, keepdims=True)
     return features / np.maximum(norms, 1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Single-writer heartbeat lease (the fleet worker-lease pattern)
+# ---------------------------------------------------------------------------
+
+class StoreWriterLease:
+    """File-backed single-writer lease over a store directory.
+
+    Same design as the serve fleet's worker leases (serve/fleet.py), for
+    the same reason the fleet chose files over a coordination service: the
+    lease must survive — and be *inspectable* after — the exact failure
+    modes it guards against (SIGKILL, OOM, preemption). The holder
+    publishes ``{pid, owner, token, renewed_at, lease_s}`` with
+    write-to-temp + atomic rename and renews ``renewed_at`` from a
+    heartbeat thread; a lease whose ``renewed_at`` is older than
+    ``lease_s`` is stale and taken over (counted + logged — a takeover is
+    always evidence of a dead writer). A malformed lease file reads as
+    absent-but-loud, never as held. Acquisition is read-check-replace, not
+    a kernel lock: the window is one rename against a multi-second lease,
+    and both sides of a real race are visible in the journal.
+    """
+
+    def __init__(self, store_dir: str | Path, *, owner: str = "",
+                 lease_s: float = DEFAULT_LEASE_S, heartbeat_s: float = 0.0):
+        self.dir = Path(store_dir)
+        self.path = self.dir / LEASE_NAME
+        self.owner = owner or f"pid{os.getpid()}"
+        self.lease_s = float(lease_s)
+        self.heartbeat_s = (float(heartbeat_s) if heartbeat_s > 0
+                            else max(0.2, self.lease_s / 3.0))
+        # token makes renew/release self-owned: a taken-over writer that
+        # limps back can never delete or renew the usurper's lease
+        self.token = (f"{os.getpid()}.{threading.get_ident()}."
+                      f"{os.urandom(4).hex()}")
+        self.held = False
+        self._started_at = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _read(self) -> Optional[dict]:
+        try:
+            raw = self.path.read_text()
+        except FileNotFoundError:
+            return None
+        except OSError as e:
+            R.log_event("store_lease_unreadable", path=str(self.path),
+                        error=repr(e))
+            return None
+        try:
+            doc = json.loads(raw)
+            if not isinstance(doc, dict):
+                raise ValueError("lease doc is not an object")
+            return doc
+        except ValueError as e:
+            # malformed = absent-but-loud (torn lease write from a killed
+            # holder) — it must not wedge the store forever
+            R.log_event("store_lease_malformed", path=str(self.path),
+                        error=repr(e))
+            tracing.registry().counter("search/store_lease_malformed").inc()
+            return None
+
+    def _write(self) -> None:
+        doc = {"owner": self.owner, "pid": os.getpid(), "token": self.token,
+               "lease_s": self.lease_s, "started_at": self._started_at,
+               "renewed_at": time.time()}
+        tmp = self.path.with_name(
+            f"{LEASE_NAME}.tmp.{os.getpid()}.{threading.get_ident()}")
+        tmp.write_text(json.dumps(doc, sort_keys=True) + "\n")
+        os.replace(tmp, self.path)
+
+    def acquire(self) -> "StoreWriterLease":
+        """Take the lease or raise :class:`StoreLeaseHeldError`."""
+        self.dir.mkdir(parents=True, exist_ok=True)
+        now = time.time()
+        doc = self._read()
+        if doc is not None and doc.get("token") != self.token:
+            renewed = float(doc.get("renewed_at") or 0.0)
+            held_s = float(doc.get("lease_s") or 0.0)
+            if now <= renewed + held_s:
+                raise StoreLeaseHeldError(
+                    f"store {self.dir} writer lease held by "
+                    f"{doc.get('owner')!r} (pid {doc.get('pid')}, renewed "
+                    f"{now - renewed:.1f}s ago, lease {held_s:.1f}s) — one "
+                    "writer per store; retry after it finalizes or its "
+                    "lease expires")
+            R.log_event("store_lease_takeover", path=str(self.path),
+                        stale_owner=doc.get("owner"),
+                        stale_pid=doc.get("pid"),
+                        stale_for_s=round(now - renewed - held_s, 3))
+            tracing.registry().counter("search/store_lease_takeover").inc()
+            log.warning("store %s: taking over stale writer lease from %r "
+                        "(pid %s)", self.dir, doc.get("owner"),
+                        doc.get("pid"))
+        self._started_at = now
+        self._write()
+        self.held = True
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="store-lease")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.heartbeat_s):
+            try:
+                self._write()
+            except OSError as e:  # keep renewing through transient FS blips
+                R.log_event("store_lease_renew_failed", path=str(self.path),
+                            error=repr(e))
+
+    def renew(self) -> None:
+        self._write()
+
+    def release(self) -> None:
+        """Stop the heartbeat and delete the lease iff it is still ours."""
+        if not self.held:
+            return
+        self.held = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, 2 * self.heartbeat_s))
+            self._thread = None
+        doc = self._read()
+        if doc is not None and doc.get("token") == self.token:
+            try:
+                self.path.unlink()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "StoreWriterLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
 
 
 # ---------------------------------------------------------------------------
@@ -92,7 +279,8 @@ class EmbeddingStoreWriter:
 
     def __init__(self, store_dir: str | Path, *, embed_dim: Optional[int] = None,
                  shard_rows: Optional[int] = None, normalize: bool = False,
-                 _resume: Optional[dict] = None):
+                 _resume: Optional[dict] = None,
+                 lease: Optional[StoreWriterLease] = None):
         self.dir = Path(store_dir)
         self.dir.mkdir(parents=True, exist_ok=True)
         self.embed_dim = embed_dim
@@ -103,25 +291,38 @@ class EmbeddingStoreWriter:
         self._shards: list[dict] = list((_resume or {}).get("shards", []))
         self._total = int((_resume or {}).get("total", 0))
         self._sources: list[str] = list((_resume or {}).get("sources", []))
+        self._snapshot = int((_resume or {}).get("snapshot", 0))
+        self._wal_through = int((_resume or {}).get("wal_through", 0))
+        self._live = False
+        # single-writer discipline: hold the store's writer lease for the
+        # writer's whole life (a borrowed lease — live-tier compaction —
+        # stays owned by the borrower)
+        if lease is not None:
+            self._lease, self._owns_lease = lease, False
+        else:
+            self._lease = StoreWriterLease(self.dir).acquire()
+            self._owns_lease = True
 
     # -- construction --------------------------------------------------------
 
     @classmethod
     def create(cls, store_dir: str | Path, *, embed_dim: Optional[int] = None,
-               shard_rows: Optional[int] = None,
-               normalize: bool = False) -> "EmbeddingStoreWriter":
+               shard_rows: Optional[int] = None, normalize: bool = False,
+               lease: Optional[StoreWriterLease] = None) -> "EmbeddingStoreWriter":
         """Start a NEW store; refuses to clobber a committed one (build over
         an existing manifest would orphan its shards — use append)."""
-        if (Path(store_dir) / MANIFEST_NAME).exists():
+        if ((Path(store_dir) / MANIFEST_NAME).exists()
+                or (Path(store_dir) / CURRENT_NAME).exists()):
             raise StoreError(
                 f"{store_dir} already holds a committed store "
                 f"({MANIFEST_NAME} exists) — use append, or point build at "
                 "a fresh directory")
         return cls(store_dir, embed_dim=embed_dim, shard_rows=shard_rows,
-                   normalize=normalize)
+                   normalize=normalize, lease=lease)
 
     @classmethod
-    def append(cls, store_dir: str | Path) -> "EmbeddingStoreWriter":
+    def append(cls, store_dir: str | Path, *,
+               lease: Optional[StoreWriterLease] = None) -> "EmbeddingStoreWriter":
         """Extend a committed store: new rows land in NEW shards (committed
         shards are immutable), and the manifest re-commits atomically at
         finalize — a crash mid-append leaves the previous store intact."""
@@ -129,7 +330,33 @@ class EmbeddingStoreWriter:
         return cls(store_dir, embed_dim=int(manifest["embed_dim"]),
                    shard_rows=int(manifest["shard_rows"]),
                    normalize=bool(manifest["normalized"]),
-                   _resume=manifest)
+                   _resume=manifest, lease=lease)
+
+    def close(self) -> None:
+        """Release the writer lease without committing (the abort path;
+        :meth:`finalize` calls this after the manifest lands). Idempotent."""
+        if self._owns_lease and self._lease is not None:
+            self._lease.release()
+        self._lease = None
+
+    def __enter__(self) -> "EmbeddingStoreWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- live tier hooks (dcr_tpu.search.livestore) --------------------------
+
+    def mark_live(self) -> None:
+        """Commit versioned (``store_manifest.v<N>.json`` + ``CURRENT``)
+        even on a store that never had a ``CURRENT`` pointer — the live
+        tier's first compaction promotes the store to snapshot serving."""
+        self._live = True
+
+    def mark_wal_through(self, seq: int) -> None:
+        """Record the highest WAL sequence folded into this commit; WAL
+        replay after a crash skips rows at or below it (idempotence)."""
+        self._wal_through = max(self._wal_through, int(seq))
 
     # -- ingestion -----------------------------------------------------------
 
@@ -213,10 +440,22 @@ class EmbeddingStoreWriter:
         tracing.registry().counter("search/ingest_rows_total").inc(take)
         self._pending -= take
 
-    def finalize(self) -> Path:
-        """Flush the tail shard and commit the manifest (atomically, last)."""
+    def finalize(self, *,
+                 _pre_current: Optional[Callable[[], None]] = None) -> Path:
+        """Flush the tail shard and commit the manifest (atomically, last).
+
+        Legacy stores re-commit the single ``store_manifest.json``. A live
+        store (``CURRENT`` exists, resumed from a versioned snapshot, or
+        :meth:`mark_live`) commits ``store_manifest.v<N+1>.json`` first and
+        then flips ``CURRENT`` — the flip IS the commit point, so a crash
+        between the two leaves the previous snapshot serving.
+        ``_pre_current`` runs between the two writes (the live tier's
+        deterministic ``compact_crash`` injection point)."""
         while self._pending:
             self._flush_shard(self.shard_rows)
+        live = (self._live or self._snapshot > 0
+                or (self.dir / CURRENT_NAME).exists())
+        snapshot = self._snapshot + 1 if live else 0
         doc = {
             "version": STORE_VERSION,
             "kind": STORE_KIND,
@@ -225,16 +464,27 @@ class EmbeddingStoreWriter:
             "shard_rows": self.shard_rows,
             "normalized": self.normalize,
             "total": self._total,
+            "snapshot": snapshot,
+            "wal_through": self._wal_through,
             "shards": self._shards,
             "sources": self._sources,
         }
-        path = self.dir / MANIFEST_NAME
-        tmp = path.with_name(f"{MANIFEST_NAME}.tmp.{os.getpid()}")
+        name = versioned_manifest_name(snapshot) if live else MANIFEST_NAME
+        path = self.dir / name
+        tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
         tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
         os.replace(tmp, path)
+        if live:
+            if _pre_current is not None:
+                _pre_current()
+            cur = self.dir / CURRENT_NAME
+            ctmp = cur.with_name(f"{CURRENT_NAME}.tmp.{os.getpid()}")
+            ctmp.write_text(name + "\n")
+            os.replace(ctmp, cur)
         tracing.event("search/store_finalized", shards=len(self._shards),
-                      rows=self._total)
+                      rows=self._total, snapshot=snapshot)
         tracing.registry().gauge("search/store_rows").set(self._total)
+        self.close()
         return path
 
 
@@ -242,16 +492,59 @@ class EmbeddingStoreWriter:
 # Manifest + reader: verify before load, quarantine on damage
 # ---------------------------------------------------------------------------
 
+def _read_current_pointer(store_dir: Path, *,
+                          quarantine: bool = True) -> Optional[str]:
+    """Resolve ``CURRENT`` to a versioned manifest filename, or None for a
+    legacy (pre-live) store. A pointer naming anything but a versioned
+    manifest is corruption of the commit point itself: quarantined +
+    counted + typed, exactly like a corrupt manifest."""
+    cur = Path(store_dir) / CURRENT_NAME
+    try:
+        raw = cur.read_text()
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        raise StoreError(f"store CURRENT pointer unreadable: {e!r}") from e
+    name = raw.strip()
+    if not _VERSIONED_RE.match(name):
+        dest = quarantine_rename(cur) if quarantine else None
+        R.log_event("store_manifest_corrupt", error=f"CURRENT names {name!r}",
+                    path=str(cur),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter("search/store_manifest_corrupt").inc()
+        raise StoreError(
+            f"store manifest corrupt (CURRENT names {name!r}, not a "
+            "versioned manifest); quarantined — recover or rebuild the "
+            "store")
+    return name
+
+
+def snapshot_version(store_dir: str | Path) -> int:
+    """The store's current snapshot: the ``CURRENT`` pointer's version for
+    a live store, 0 for a legacy single-manifest (or absent) store."""
+    name = _read_current_pointer(Path(store_dir), quarantine=False)
+    return int(_VERSIONED_RE.match(name).group(1)) if name else 0
+
+
 def read_store_manifest(store_dir: Path, *, quarantine: bool = True) -> dict:
-    """Load + structurally verify ``store_manifest.json``. Raises
-    :class:`StoreError`; a corrupt (unparseable) manifest is additionally
-    quarantine-renamed so the next incarnation isn't poisoned by the same
-    bytes — unless ``quarantine=False`` (read-only inspection of a
-    possibly-shared store must not rename anything)."""
-    path = Path(store_dir) / MANIFEST_NAME
+    """Load + structurally verify the store manifest — the ``CURRENT``
+    snapshot when the store is live, else the legacy single
+    ``store_manifest.json``. Raises :class:`StoreError`; a corrupt
+    (unparseable) manifest is additionally quarantine-renamed so the next
+    incarnation isn't poisoned by the same bytes — unless
+    ``quarantine=False`` (read-only inspection of a possibly-shared store
+    must not rename anything)."""
+    current = _read_current_pointer(Path(store_dir), quarantine=quarantine)
+    name = current or MANIFEST_NAME
+    path = Path(store_dir) / name
     try:
         raw = R.read_bytes_with_retry(path, name="store_manifest")
     except FileNotFoundError:
+        if current is not None:
+            raise StoreError(
+                f"store manifest corrupt: {CURRENT_NAME} names {name} but "
+                "the file is missing — recover or rebuild the store"
+            ) from None
         raise StoreError(
             f"{store_dir} has no {MANIFEST_NAME} — not an embedding store "
             "(run `dcr-search build` first)") from None
@@ -274,6 +567,10 @@ def read_store_manifest(store_dir: Path, *, quarantine: bool = True) -> dict:
         raise StoreError(
             f"store manifest corrupt ({e}); quarantined — rebuild the "
             "store") from e
+    # the pointer, not the doc, is the commit point — trust its version
+    doc["snapshot"] = (int(_VERSIONED_RE.match(current).group(1))
+                       if current else 0)
+    doc.setdefault("wal_through", 0)
     return doc
 
 
@@ -297,6 +594,8 @@ class EmbeddingStoreReader:
         self.normalized = bool(self.manifest.get("normalized", False))
         self.shard_rows = int(self.manifest["shard_rows"])
         self.total = int(self.manifest["total"])
+        self.snapshot = int(self.manifest.get("snapshot", 0))
+        self.wal_through = int(self.manifest.get("wal_through", 0))
         self._load_seq = 0
 
     def __len__(self) -> int:
@@ -361,13 +660,30 @@ class EmbeddingStoreReader:
 
     # -- serving -------------------------------------------------------------
 
+    def check_snapshot(self) -> None:
+        """Raise :class:`StoreSnapshotChangedError` when the store's
+        snapshot moved since this reader opened. Called before every shard
+        read (one tiny pointer stat/read against a multi-MB shard load) —
+        rows from two snapshots must never mix in one iteration."""
+        now = snapshot_version(self.dir)
+        if now != self.snapshot:
+            tracing.registry().counter("search/store_snapshot_changed").inc()
+            raise StoreSnapshotChangedError(
+                f"store {self.dir} snapshot moved v{self.snapshot} -> "
+                f"v{now} mid-read — re-open the reader against the new "
+                "snapshot and retry")
+
     def iter_shards(self) -> Iterator[tuple[np.ndarray, np.ndarray]]:
         """Yield verified ``(features [n, D], keys [n])`` per surviving
         shard, manifest order. Corrupt shards are quarantined + counted and
         simply not yielded; zero survivors raises StoreError (a store that
-        can serve NOTHING must be loud, not an empty result set)."""
+        can serve NOTHING must be loud, not an empty result set). A
+        snapshot that moves mid-iteration raises the retryable
+        :class:`StoreSnapshotChangedError` before any cross-snapshot row
+        can be served."""
         survivors = 0
         for shard in self.manifest["shards"]:
+            self.check_snapshot()
             arrays = self._load_shard(shard)
             if arrays is None:
                 continue
@@ -452,6 +768,7 @@ def ingest_dumps(writer: EmbeddingStoreWriter,
             tracing.registry().counter("search/ingest_dump_failed").inc()
             log.warning("store ingest: skipping %s (%r)", dump, e)
     if rows == 0:
+        writer.close()  # aborting: the writer lease must not outlive it
         raise StoreError(
             f"ingested 0 rows from {[str(s) for s in sources]} "
             f"({skipped} dump(s) failed, {dumps} readable) — "

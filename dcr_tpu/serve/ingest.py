@@ -1,0 +1,238 @@
+"""Serve-side live ingest: stream scored generations into the store.
+
+The bridge between copy-risk scoring and the dcr-live WAL tier
+(:mod:`dcr_tpu.search.livestore`): every generation the worker scores
+already has its SSCD embedding in hand, so :class:`IngestPump` enqueues
+``(embedding, key)`` on a bounded queue and a background appender thread
+makes them durable. The response path calls :meth:`IngestPump.offer` and
+NOTHING else — it never blocks, never touches the filesystem, and when
+the queue is full the row is dropped-and-counted
+(``ingest/dropped_total``), because a slow disk must degrade provenance
+coverage, not generation latency (the bench_ingest p99 gate).
+
+The appender owns the store's writer lease. If another process holds it
+(a previous worker incarnation that hasn't expired yet), the pump sits in
+``waiting_lease`` and retries on a timed wait until the stale lease ages
+out and is taken over — the same self-healing story as the fleet worker
+lease. Every ``compact_rows`` acked-but-unfolded rows it compacts
+(``prune=False``), tells the worker to refresh its risk engine onto the
+new snapshot, then prunes — so in-flight ``/check`` queries keep the
+snapshot they started with and no row is ever served twice or missed.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Optional
+
+import numpy as np
+
+from dcr_tpu.core import tracing
+from dcr_tpu.search.livestore import DEFAULT_SEAL_ROWS, LiveStore
+from dcr_tpu.search.store import (DEFAULT_LEASE_S, StoreError,
+                                  StoreLeaseHeldError)
+
+log = logging.getLogger("dcr_tpu")
+
+#: default bound on the response-path queue (rows, not batches)
+DEFAULT_QUEUE_MAX = 1024
+
+
+class IngestPump:
+    """Bounded-queue, never-blocks producer + durable appender thread."""
+
+    def __init__(self, store_dir: str | Path, *, embed_dim: int = 512,
+                 queue_max: int = DEFAULT_QUEUE_MAX, batch_rows: int = 16,
+                 seal_rows: int = DEFAULT_SEAL_ROWS,
+                 compact_rows: int = 0, lease_s: float = DEFAULT_LEASE_S,
+                 owner: str = "",
+                 on_snapshot: Optional[Callable[[int], None]] = None):
+        self.dir = Path(store_dir)
+        self.embed_dim = int(embed_dim)
+        self.batch_rows = max(1, int(batch_rows))
+        self.seal_rows = int(seal_rows)
+        self.compact_rows = int(compact_rows)  # 0 = never auto-compact
+        self.lease_s = float(lease_s)
+        self.owner = owner or f"ingest-pump.{self.dir.name}"
+        self.on_snapshot = on_snapshot
+        self._q: "queue.Queue[tuple[float, np.ndarray, str]]" = queue.Queue(
+            maxsize=max(1, int(queue_max)))
+        self._stop = threading.Event()
+        self._live: Optional[LiveStore] = None
+        self._thread: Optional[threading.Thread] = None
+        self.status = "starting"
+        self.appended_rows = 0
+        self.dropped_rows = 0
+        self.compactions = 0
+        self.last_error = ""
+
+    # -- response path (hot): never blocks -----------------------------------
+
+    def offer(self, features_row: np.ndarray, key: str) -> bool:
+        """Enqueue one embedding row for durable append. Non-blocking by
+        construction (``put_nowait``): a full queue means the row is
+        dropped and counted, never a stalled response."""
+        row = np.asarray(features_row, np.float32).reshape(-1)
+        try:
+            self._q.put_nowait((time.time(), row, str(key)))
+        except queue.Full:
+            self.dropped_rows += 1
+            tracing.registry().counter("ingest/dropped_total").inc()
+            return False
+        tracing.registry().gauge("ingest/queue_depth").set(self._q.qsize())
+        return True
+
+    # -- appender thread ------------------------------------------------------
+
+    def start(self) -> "IngestPump":
+        self._thread = threading.Thread(target=self._run, name="ingest-pump",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def _open_with_retry(self) -> Optional[LiveStore]:
+        while not self._stop.is_set():
+            try:
+                live = LiveStore.open(self.dir, embed_dim=self.embed_dim,
+                                      seal_rows=self.seal_rows,
+                                      lease_s=self.lease_s, owner=self.owner)
+                self.status = "ok"
+                return live
+            except StoreLeaseHeldError as e:
+                # another writer (likely our crashed predecessor) still
+                # holds the lease — wait out its heartbeat, then take over
+                self.status = "waiting_lease"
+                self.last_error = str(e)
+                tracing.registry().counter(
+                    "ingest/lease_wait_total").inc()
+                self._stop.wait(max(0.5, self.lease_s / 4))
+            except StoreError as e:
+                self.status = "error"
+                self.last_error = str(e)
+                log.error("ingest: cannot open live store %s: %s",
+                          self.dir, e)
+                return None
+        return None
+
+    def _drain_batch(self, first) -> tuple[float, np.ndarray, list[str]]:
+        items = [first]
+        while len(items) < self.batch_rows:
+            try:
+                items.append(self._q.get_nowait())
+            except queue.Empty:
+                break
+        feats = np.stack([row for _, row, _ in items])
+        keys = [k for _, _, k in items]
+        return items[0][0], feats, keys
+
+    def _run(self) -> None:
+        live = self._open_with_retry()
+        if live is None:
+            return
+        self._live = live
+        reg = tracing.registry()
+        try:
+            while True:
+                try:
+                    first = self._q.get(timeout=0.2)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        break
+                    reg.gauge("ingest/lag_seconds").set(0.0)
+                    reg.gauge("ingest/queue_depth").set(0)
+                    continue
+                oldest_ts, feats, keys = self._drain_batch(first)
+                try:
+                    live.append(feats, keys)
+                    self.appended_rows += feats.shape[0]
+                except StoreError as e:
+                    # includes the injected wal_torn frame: not acked, the
+                    # batch is lost-and-counted, the pump keeps pumping
+                    self.last_error = str(e)
+                    reg.counter("ingest/append_failed_total").inc(
+                        feats.shape[0])
+                    log.warning("ingest: append failed (%d rows): %s",
+                                feats.shape[0], e)
+                reg.gauge("ingest/lag_seconds").set(
+                    max(0.0, time.time() - oldest_ts))
+                reg.gauge("ingest/queue_depth").set(self._q.qsize())
+                if (self.compact_rows > 0
+                        and live.total_rows - live.committed_total
+                        >= self.compact_rows):
+                    self._compact(live)
+        finally:
+            self._live = None
+            live.close()
+            if self.status == "ok":
+                self.status = "stopped"
+
+    def _compact(self, live: LiveStore) -> None:
+        try:
+            report = live.compact(prune=False)
+        except StoreError as e:
+            self.last_error = str(e)
+            log.error("ingest: compaction failed: %s", e)
+            return
+        self.compactions += 1
+        if self.on_snapshot is not None:
+            try:
+                # the worker swaps its risk engine onto the new snapshot
+                # BEFORE we prune, so there is never a moment where a row
+                # is in neither the engine nor the tail
+                self.on_snapshot(int(report.get("snapshot", 0)))
+            except Exception:
+                log.exception("ingest: on_snapshot callback failed "
+                              "(snapshot v%s)", report.get("snapshot"))
+        live.prune()
+
+    # -- introspection / lifecycle -------------------------------------------
+
+    def compact_now(self) -> None:
+        """Test/ops hook: force a compaction from the appender's context by
+        lowering the threshold to the next append. Synchronous version for
+        a quiesced pump."""
+        live = self._live
+        if live is not None:
+            self._compact(live)
+
+    def stats(self) -> dict:
+        live = self._live
+        doc = {"status": self.status, "queued": self._q.qsize(),
+               "appended_rows": self.appended_rows,
+               "dropped_rows": self.dropped_rows,
+               "compactions": self.compactions}
+        if self.last_error:
+            doc["last_error"] = self.last_error
+        if live is not None:
+            doc.update(snapshot=live.snapshot, total_rows=live.total_rows,
+                       tail_rows=live.tail_rows)
+        return doc
+
+    def tail(self, after_seq: int) -> tuple[np.ndarray, np.ndarray]:
+        """Live-tail provider for :class:`CopyRiskIndex` — the acked rows
+        newer than the caller's snapshot (empty until the store is open)."""
+        live = self._live
+        if live is None:
+            return (np.zeros((0, self.embed_dim), np.float32),
+                    np.zeros((0,), dtype=object))
+        return live.tail(after_seq)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Drain-and-stop: the appender finishes the queued backlog (every
+        acked row stays durable in the WAL — recovery replays it), then
+        releases the lease."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=max(1.0, timeout))
+        self._thread = None
+
+    def __enter__(self) -> "IngestPump":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -341,6 +341,7 @@ class GenerationService:
         self._risk = None
         self._risk_status = "absent"
         self._risk_done = threading.Event()
+        self._pump = None             # IngestPump (dcr-live), risk+ingest on
         self._evidence = None
         if cfg.risk.index_path or cfg.risk.store_dir:
             self._risk_status = "loading"
@@ -510,7 +511,13 @@ class GenerationService:
 
     def stop(self, timeout: Optional[float] = None) -> bool:
         self.begin_drain()
-        return self.join_drained(timeout)
+        drained = self.join_drained(timeout)
+        pump = self._pump
+        if pump is not None:
+            # after the worker drained: the pump finishes its queued
+            # backlog (WAL-durable) and releases the writer lease
+            pump.stop()
+        return drained
 
     @property
     def draining(self) -> bool:
@@ -686,8 +693,11 @@ class GenerationService:
         with self._samplers_lock:
             warm = len(self._samplers)
         total = max(len(self._warm_plan or ()), warm)
-        return {"status": self.health(), "buckets_warm": warm,
-                "buckets_total": total, "risk": self._risk_status}
+        doc = {"status": self.health(), "buckets_warm": warm,
+               "buckets_total": total, "risk": self._risk_status}
+        if self._pump is not None:
+            doc["ingest"] = self._pump.stats()
+        return doc
 
     def _uncond_embedding(self) -> np.ndarray:
         if self._uncond is None:
@@ -740,6 +750,29 @@ class GenerationService:
                  "(threshold %.3f%s)", len(index), source,
                  cfg.risk.threshold,
                  f", evidence -> {ev_dir}" if ev_dir else "")
+        if cfg.ingest.enabled and cfg.risk.store_dir:
+            self._start_ingest(index)
+
+    def _start_ingest(self, index) -> None:
+        """dcr-live: stream every scored generation's SSCD embedding into
+        the store. The pump owns the writer lease and the compaction loop;
+        the index's live-tail hook makes acked-but-uncompacted rows visible
+        to `/check` and per-response scoring immediately."""
+        from dcr_tpu.serve.ingest import IngestPump
+
+        icfg = self.cfg.ingest
+        pump = IngestPump(
+            self.cfg.risk.store_dir, embed_dim=index._store.embed_dim,
+            queue_max=icfg.queue_max, batch_rows=icfg.batch_rows,
+            seal_rows=icfg.seal_rows, compact_rows=icfg.compact_rows,
+            lease_s=icfg.lease_s,
+            owner=f"serve-worker.{os.getpid()}",
+            on_snapshot=lambda v: index.refresh_store())
+        index.live_tail = pump.tail
+        self._pump = pump.start()
+        log.info("serve: live ingest on — store %s (queue %d, compact "
+                 "every %d rows)", self.cfg.risk.store_dir, icfg.queue_max,
+                 icfg.compact_rows)
 
     def risk_status(self) -> str:
         """absent | loading | ok | failed."""
@@ -765,7 +798,7 @@ class GenerationService:
         try:
             with tracing.span("serve/risk_score", batch=len(requests),
                               request_ids=ids, trace_ids=traces) as sp:
-                scores = index.score_batch(images)
+                scores, feats = index.score_batch_with_features(images)
                 agg = copyrisk.observe_scores(scores, rcfg.threshold)
                 # per-row sims/prompts ride the span: tools/risk_report's
                 # per-prompt breakdown and trace_report's percentiles come
@@ -793,6 +826,13 @@ class GenerationService:
                         img, score, rcfg.threshold, request_id=req.id,
                         prompt=req.prompt, seed=req.seed,
                         bucket=list(tuple(req.bucket)), trace=req.trace_id)
+        pump = self._pump
+        if pump is not None:
+            # enqueue-and-forget: offer() never blocks — a full queue drops
+            # the row and bumps dcr_ingest_dropped_total, generation latency
+            # is untouched (the bench_ingest p99 gate)
+            for req, row in zip(requests, feats):
+                pump.offer(row, f"gen/{req.trace_id or req.id}")
 
     def check(self, body: dict) -> dict:
         """``POST /check``: score ONE submitted image against the train
@@ -1041,6 +1081,8 @@ class GenerationService:
         risk = self._risk
         d["risk"] = {"status": self._risk_status,
                      "index_size": len(risk) if risk is not None else 0}
+        if self._pump is not None:
+            d["ingest"] = self._pump.stats()
         with self._samplers_lock:     # worker thread mutates concurrently
             d["compiled_buckets"] = [tuple(b) for b in self._samplers]
         return d

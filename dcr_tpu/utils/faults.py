@@ -75,6 +75,25 @@ coordinated-recovery tests. Supported kinds and their hook points:
   counter bumps, and the store serves the surviving rows. This is how CI
   proves a damaged store can never crash a query or return scores from
   corrupt rows. ``store_shard_corrupt@load=0`` poisons the first shard.
+- ``wal_torn`` — live-ingest WAL append (search/livestore.py), coord
+  ``append`` (per-writer append index): writes a deliberately torn frame
+  (partial payload, no commit marker) instead of the real record and
+  raises without acking — exactly the bytes a crash mid-``write()``
+  leaves. Recovery truncates the torn tail, bumps ``ingest/torn_total``,
+  and never serves the row; the record was never acked so losing it is
+  correct. ``wal_torn@append=3`` tears the fourth append.
+- ``ingest_crash`` — live-ingest WAL append (search/livestore.py), coord
+  ``append``: writes a partial frame then SIGKILLs the process mid-append
+  — the full crash, not a simulation. The chaos e2e restarts, recovers,
+  and pins the recovered store query-equal (scores AND keys) to a rebuilt
+  store over the acked rows. ``ingest_crash@append=5`` kills during the
+  sixth append.
+- ``compact_crash`` — WAL compaction (search/livestore.py), coord ``seal``
+  (per-writer compaction index): SIGKILLs after the new versioned manifest
+  is written but BEFORE the atomic ``CURRENT`` flip — the worst instant.
+  Recovery proves the previous snapshot still serves, the WAL replays, and
+  the next compaction overwrites the orphaned manifest cleanly.
+  ``compact_crash@seal=0`` kills the first compaction.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

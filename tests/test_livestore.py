@@ -1,0 +1,871 @@
+"""dcr-live: crash-safe streaming provenance ingest (ISSUE 16).
+
+The recovery matrix for search/livestore.py + serve/ingest.py: WAL frame
+scanning and torn-tail truncation at every byte boundary, single-writer
+lease contention (in-process and two-process) with stale takeover, crash-
+during-compaction snapshot rollback, reader snapshot isolation, the
+bounded never-blocks ingest queue, the CLI recover/compact surface — and
+the crash-equivalence gate: subprocesses SIGKILLed mid-append and
+mid-compaction recover into a store that answers queries EXACTLY equal
+(scores and keys) to a post-hoc rebuilt store over the acked rows.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dcr_tpu.core import tracing
+from dcr_tpu.search.livestore import (COMMIT_MAGIC, LiveStore, RECORD_MAGIC,
+                                      _encode_record, load_wal_tail,
+                                      query_live, scan_wal_bytes)
+from dcr_tpu.search.store import (EmbeddingStoreReader, EmbeddingStoreWriter,
+                                  CURRENT_NAME, StoreError,
+                                  StoreLeaseHeldError,
+                                  StoreSnapshotChangedError, StoreWriterLease,
+                                  read_store_manifest, snapshot_version)
+from dcr_tpu.utils import faults
+
+DIM = 8
+
+
+def _counter(name: str) -> int:
+    reg = tracing.registry()
+    return {**reg.counters("ingest/"), **reg.counters("search/")}.get(name, 0)
+
+
+def _rows(rng, n, dim=DIM):
+    return rng.standard_normal((n, dim)).astype(np.float32)
+
+
+def _fill(live, rows_mat, prefix="k", batch=4):
+    seqs = []
+    for start in range(0, rows_mat.shape[0], batch):
+        chunk = rows_mat[start:start + batch]
+        seqs.append(live.append(
+            chunk, [f"{prefix}{start + j}" for j in range(len(chunk))]))
+    return seqs
+
+
+def _child_env():
+    repo = Path(__file__).parent.parent
+    env = {k: v for k, v in os.environ.items() if k != "DCR_FAULTS"}
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    return env, repo
+
+
+# ---------------------------------------------------------------------------
+# 1. WAL framing + the torn-tail truncation matrix
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_wal_record_roundtrip_and_garbage_suffix(rng_np):
+    feats = _rows(rng_np, 3)
+    keys = np.asarray(["a", "b", "c"], dtype=str)
+    blob = _encode_record(7, feats, keys)
+    records, good_end = scan_wal_bytes(blob)
+    assert good_end == len(blob) and len(records) == 1
+    seq, f, k = records[0]
+    assert seq == 7 and np.array_equal(f, feats) and list(k) == ["a", "b", "c"]
+    # a garbage suffix after a committed frame is a torn tail, not a crash
+    records, good_end = scan_wal_bytes(blob + b"\x00garbage")
+    assert len(records) == 1 and good_end == len(blob)
+
+
+@pytest.mark.fast
+def test_torn_tail_truncated_at_every_frame_boundary(rng_np):
+    """A crash can interrupt the writer between ANY two bytes: whatever
+    prefix of the last frame survives, scanning keeps exactly the committed
+    records and reports the torn offset."""
+    r1 = _encode_record(1, _rows(rng_np, 2), np.asarray(["a", "b"]))
+    r2 = _encode_record(2, _rows(rng_np, 2), np.asarray(["c", "d"]))
+    cuts = [
+        len(r1) + 2,                              # inside r2's magic
+        len(r1) + 6,                              # inside the header length
+        len(r1) + 30,                             # inside the header JSON
+        len(r1) + len(r2) // 2,                   # inside the payload
+        len(r1) + len(r2) - len(COMMIT_MAGIC),    # before the commit marker
+        len(r1) + len(r2) - 1,                    # inside the commit marker
+    ]
+    for cut in cuts:
+        records, good_end = scan_wal_bytes((r1 + r2)[:cut])
+        assert len(records) == 1 and good_end == len(r1), cut
+    # bit rot inside the payload: sha mismatch = torn, never served
+    damaged = bytearray(r1 + r2)
+    damaged[len(r1) + 60] ^= 0xFF
+    records, good_end = scan_wal_bytes(bytes(damaged))
+    assert len(records) == 1 and good_end == len(r1)
+
+
+@pytest.mark.fast
+def test_recovery_truncates_torn_tail_counts_and_serves_acked(tmp_path,
+                                                              rng_np):
+    store = tmp_path / "store"
+    rows_mat = _rows(rng_np, 8)
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, rows_mat, batch=4)
+    wal = sorted((store / "wal").glob("wal_*.log"))[-1]
+    data = wal.read_bytes()
+    wal.write_bytes(data[:len(data) - 5])  # tear the second record
+    before = _counter("ingest/torn_total")
+    with LiveStore.open(store) as live:
+        assert live.torn_segments == 1
+        assert live.recovered_rows == 4          # the acked-and-committed rows
+        feats, keys = live.tail()
+        assert np.array_equal(feats, rows_mat[:4])
+        # recovery truncated: the next append lands after the good prefix
+        live.append(rows_mat[4:], [f"re{j}" for j in range(4)])
+    assert _counter("ingest/torn_total") == before + 1
+    with LiveStore.open(store) as live:
+        assert live.torn_segments == 0           # truncation healed the file
+        feats, keys = live.tail()
+        assert feats.shape[0] == 8 and list(keys[4:]) == [
+            f"re{j}" for j in range(4)]
+
+
+@pytest.mark.fast
+def test_append_validation_rejects_bad_batches(tmp_path, rng_np):
+    with LiveStore.open(tmp_path / "s", embed_dim=DIM) as live:
+        live.append(_rows(rng_np, 2), ["a", "b"])
+        with pytest.raises(StoreError, match="width"):
+            live.append(rng_np.standard_normal((2, 5)).astype(np.float32),
+                        ["a", "b"])
+        with pytest.raises(StoreError, match="keys"):
+            live.append(_rows(rng_np, 2), ["a"])
+        with pytest.raises(StoreError, match="empty"):
+            live.append(np.zeros((0, DIM), np.float32), [])
+        bad = _rows(rng_np, 2)
+        bad[1, 3] = np.nan
+        with pytest.raises(StoreError, match="finite"):
+            live.append(bad, ["a", "b"])
+
+
+# ---------------------------------------------------------------------------
+# 2. compaction: versioned snapshots, idempotent replay, WAL GC
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_compact_publishes_versioned_snapshots(tmp_path, rng_np):
+    store = tmp_path / "store"
+    rows_mat = _rows(rng_np, 12)
+    with LiveStore.open(store, embed_dim=DIM, seal_rows=4) as live:
+        _fill(live, rows_mat[:8], batch=4)
+        rep = live.compact()
+        assert rep["snapshot"] == 1 and rep["folded_rows"] == 8
+        assert (store / "store_manifest.v1.json").exists()
+        assert (store / CURRENT_NAME).read_text().strip().endswith("v1.json")
+        assert not list((store / "wal").glob("wal_*.log"))  # folded + GC'd
+        _fill(live, rows_mat[8:], prefix="t", batch=4)
+        assert live.compact()["snapshot"] == 2
+    doc = read_store_manifest(store)
+    assert doc["snapshot"] == 2 and doc["total"] == 12
+    assert doc["wal_through"] == 3               # 3 appends -> seqs 1..3
+    reader = EmbeddingStoreReader(store)
+    assert reader.snapshot == 2 and reader.total == 12
+    # v1 manifest remains on disk: in-flight readers keep their snapshot
+    assert (store / "store_manifest.v1.json").exists()
+
+
+@pytest.mark.fast
+def test_recovery_skips_rows_already_folded(tmp_path, rng_np):
+    """Crash between manifest commit and WAL GC: the segment survives but
+    every record's seq <= wal_through — replay must not double-ingest."""
+    store = tmp_path / "store"
+    rows_mat = _rows(rng_np, 8)
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, rows_mat, batch=4)
+        wal_files = sorted((store / "wal").glob("wal_*.log"))
+        stash = [(p.name, p.read_bytes()) for p in wal_files]
+        live.compact()
+    for name, data in stash:                     # resurrect the folded WAL
+        (store / "wal" / name).write_bytes(data)
+    before = _counter("ingest/recovered_total")
+    with LiveStore.open(store) as live:
+        assert live.recovered_rows == 0          # nothing unfolded
+        assert live.total_rows == 8              # and nothing doubled
+        assert not list((store / "wal").glob("wal_*.log"))  # GC finished
+    assert _counter("ingest/recovered_total") == before
+
+
+@pytest.mark.fast
+def test_live_store_refuses_normalized_store(tmp_path, rng_np):
+    store = tmp_path / "store"
+    w = EmbeddingStoreWriter(store, embed_dim=DIM, normalize=True)
+    w.add(_rows(rng_np, 4), [f"k{j}" for j in range(4)])
+    w.finalize()
+    with pytest.raises(StoreError, match="normaliz"):
+        LiveStore.open(store)
+
+
+@pytest.mark.fast
+def test_seal_rows_rolls_wal_segments(tmp_path, rng_np):
+    store = tmp_path / "store"
+    with LiveStore.open(store, embed_dim=DIM, seal_rows=4) as live:
+        _fill(live, _rows(rng_np, 12), batch=4)
+    assert len(list((store / "wal").glob("wal_*.log"))) == 3
+
+
+# ---------------------------------------------------------------------------
+# 3. the writer lease: one writer per store, stale takeover
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_concurrent_builders_get_typed_lease_error(tmp_path, rng_np):
+    store = tmp_path / "store"
+    w1 = EmbeddingStoreWriter(store, embed_dim=DIM)
+    with pytest.raises(StoreLeaseHeldError, match="one writer per store"):
+        EmbeddingStoreWriter(store, embed_dim=DIM)
+    with pytest.raises(StoreLeaseHeldError):
+        LiveStore.open(store)
+    w1.add(_rows(rng_np, 4), [f"k{j}" for j in range(4)])
+    w1.finalize()                                # releases the lease
+    with LiveStore.open(store) as live:          # now acquirable
+        assert live.committed_total == 4
+
+
+@pytest.mark.fast
+def test_two_process_writer_contention(tmp_path, rng_np):
+    """A second PROCESS appending to a held store gets the typed error —
+    the ROADMAP-flagged single-builder race, closed."""
+    store = tmp_path / "store"
+    w = EmbeddingStoreWriter(store, embed_dim=DIM)
+    w.add(_rows(rng_np, 4), [f"k{j}" for j in range(4)])
+    env, repo = _child_env()
+    child = (
+        "import sys\n"
+        "from dcr_tpu.search.store import EmbeddingStoreWriter, "
+        "StoreLeaseHeldError\n"
+        "try:\n"
+        f"    EmbeddingStoreWriter({str(store)!r}, embed_dim={DIM})\n"
+        "except StoreLeaseHeldError as e:\n"
+        "    print('HELD:', e); sys.exit(21)\n"
+        "sys.exit(0)\n")
+    proc = subprocess.run([sys.executable, "-c", child], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 21, proc.stdout + proc.stderr
+    assert "writer lease held" in proc.stdout
+    w.finalize()
+
+
+@pytest.mark.fast
+def test_stale_lease_taken_over(tmp_path, rng_np):
+    store = tmp_path / "store"
+    live = LiveStore.open(store, embed_dim=DIM, lease_s=0.3)
+    live._lease._thread = None                   # silence its heartbeat
+    live._lease._stop.set()
+    time.sleep(0.5)                              # let the lease expire
+    before = _counter("search/store_lease_takeover")
+    with LiveStore.open(store, embed_dim=DIM) as live2:
+        live2.append(_rows(rng_np, 2), ["a", "b"])
+    assert _counter("search/store_lease_takeover") == before + 1
+
+
+# ---------------------------------------------------------------------------
+# 4. reader snapshot isolation
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_reader_raises_typed_retryable_on_current_swap(tmp_path, rng_np):
+    store = tmp_path / "store"
+    rows_mat = _rows(rng_np, 12)
+    with LiveStore.open(store, embed_dim=DIM, store_shard_rows=2) as live:
+        _fill(live, rows_mat[:8], batch=2)       # 4 shards via compaction
+        live.compact()
+    reader = EmbeddingStoreReader(store)
+    it = reader.iter_shards()
+    next(it)                                     # mid-iteration...
+    with LiveStore.open(store,
+                        store_shard_rows=2) as live:  # ...the snapshot moves
+        _fill(live, rows_mat[8:], prefix="t", batch=4)
+        live.compact()
+    with pytest.raises(StoreSnapshotChangedError, match="re-open") as ei:
+        for _ in it:
+            pass
+    assert ei.value.retryable is True
+    # the retry lands on the new snapshot and reads a consistent corpus
+    reader2 = EmbeddingStoreReader(store)
+    assert reader2.snapshot == 2
+    assert sum(f.shape[0] for f, _ in reader2.iter_shards()) == 12
+
+
+@pytest.mark.fast
+def test_query_live_pairs_engine_snapshot_with_wal_tail(tmp_path, rng_np,
+                                                        cpu_devices):
+    """Committed + tail = one consistent corpus: no row twice, none lost,
+    and results EXACTLY equal a one-shot rebuilt store."""
+    rows_mat = _rows(rng_np, 24)
+    keys = [f"k{j:02d}" for j in range(24)]
+    live_dir = tmp_path / "live"
+    with LiveStore.open(live_dir, embed_dim=DIM) as live:
+        for s in range(0, 16, 4):
+            live.append(rows_mat[s:s + 4], keys[s:s + 4])
+        live.compact()
+        for s in range(16, 24, 4):
+            live.append(rows_mat[s:s + 4], keys[s:s + 4])
+    rebuilt_dir = tmp_path / "rebuilt"
+    w = EmbeddingStoreWriter(rebuilt_dir, embed_dim=DIM)
+    w.add(rows_mat, keys)
+    w.finalize()
+    q = _rows(rng_np, 5)
+    from dcr_tpu.search.shardindex import open_engine
+
+    live_scores, live_keys = query_live(live_dir, q, top_k=3,
+                                        segment_rows=8)
+    reb_scores, reb_keys = open_engine(rebuilt_dir, top_k=3, query_batch=5,
+                                       segment_rows=8).query(q)
+    assert np.array_equal(live_scores, reb_scores)
+    assert np.array_equal(np.asarray(live_keys, dtype=str),
+                          np.asarray(reb_keys, dtype=str))
+
+
+@pytest.mark.fast
+def test_query_live_tail_only_matches_numpy_brute(tmp_path, rng_np,
+                                                  cpu_devices):
+    store = tmp_path / "walonly"
+    rows_mat = _rows(rng_np, 10)
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, rows_mat, batch=5)
+    q = _rows(rng_np, 3)
+    scores, keys = query_live(store, q, top_k=2)
+    sims = q @ rows_mat.T
+    expect = np.sort(sims, axis=1)[:, ::-1][:, :2]
+    assert np.allclose(scores, expect, atol=1e-6)
+    with pytest.raises(StoreError, match="neither"):
+        query_live(tmp_path / "empty", q, top_k=1)
+
+
+# ---------------------------------------------------------------------------
+# 5. crash equivalence: SIGKILL mid-append and mid-compaction
+# ---------------------------------------------------------------------------
+
+def _open_live_retry(store_dir: Path, timeout: float = 60.0, **kw) -> LiveStore:
+    """Open after a SIGKILLed writer: its heartbeat died with it, so the
+    lease must AGE OUT before takeover — exactly the production restart."""
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            return LiveStore.open(store_dir, **kw)
+        except StoreLeaseHeldError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.3)
+
+
+def _rebuild_over(store_dir: Path, out_dir: Path) -> int:
+    """Post-hoc rebuild: committed shards + every acked WAL row."""
+    w = EmbeddingStoreWriter(out_dir, embed_dim=DIM)
+    total = 0
+    if (store_dir / CURRENT_NAME).exists() or (
+            store_dir / "store_manifest.json").exists():
+        for feats, keys in EmbeddingStoreReader(store_dir).iter_shards():
+            w.add(feats, [str(k) for k in keys])
+            total += feats.shape[0]
+    feats, keys, _ = load_wal_tail(store_dir, embed_dim=DIM)
+    if len(feats):
+        w.add(feats, [str(k) for k in keys])
+        total += feats.shape[0]
+    w.finalize()
+    return total
+
+
+_CHILD_APPEND = """
+import sys
+import numpy as np
+from dcr_tpu.search.livestore import LiveStore
+from dcr_tpu.utils import faults
+
+store, spec = sys.argv[1], sys.argv[2]
+faults.install(spec)
+rng = np.random.default_rng(11)
+with LiveStore.open(store, embed_dim={dim}, lease_s=2.0) as live:
+    for i in range(10):
+        live.append(rng.standard_normal((3, {dim})).astype(np.float32),
+                    ["b%d_%d" % (i, j) for j in range(3)])
+print("SURVIVED")  # only reachable if the fault never fired
+sys.exit(7)
+"""
+
+_CHILD_COMPACT = """
+import sys
+import numpy as np
+from dcr_tpu.search.livestore import LiveStore
+from dcr_tpu.utils import faults
+
+store, spec = sys.argv[1], sys.argv[2]
+faults.install(spec)
+rng = np.random.default_rng(12)
+with LiveStore.open(store, lease_s=2.0) as live:
+    live.append(rng.standard_normal((4, {dim})).astype(np.float32),
+                ["c%d" % j for j in range(4)])
+    live.compact()
+print("SURVIVED")
+sys.exit(7)
+"""
+
+
+def _run_child(script, store, spec, *, expect_sigkill=True):
+    env, repo = _child_env()
+    proc = subprocess.run(
+        [sys.executable, "-c", script.format(dim=DIM), str(store), spec],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=240)
+    if expect_sigkill:
+        assert proc.returncode == -signal.SIGKILL, (
+            proc.returncode, proc.stdout, proc.stderr)
+    return proc
+
+
+@pytest.mark.fast
+def test_sigkill_mid_append_recovers_query_equal(tmp_path, rng_np,
+                                                 cpu_devices):
+    """The crash-equivalence gate, kill point 1: a child process dies BY
+    SIGKILL halfway through an append; recovery serves exactly the acked
+    rows, query-equal (scores AND keys) to a post-hoc rebuilt store."""
+    store = tmp_path / "store"
+    # a committed base + live WAL before the crash
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, _rows(rng_np, 8), prefix="base", batch=4)
+        live.compact()
+    _run_child(_CHILD_APPEND, store, "ingest_crash@append=4")
+    before = _counter("ingest/torn_total")
+    with _open_live_retry(store) as live:        # stale lease taken over
+        assert live.torn_segments >= 1           # the partial frame
+        assert live.recovered_rows == 12         # appends 0..3 acked, 3 rows each
+        report = live.report()
+    assert _counter("ingest/torn_total") == before + 1
+    rebuilt = tmp_path / "rebuilt"
+    assert _rebuild_over(store, rebuilt) == 8 + 12
+    q = _rows(rng_np, 4)
+    from dcr_tpu.search.shardindex import open_engine
+
+    live_scores, live_keys = query_live(store, q, top_k=3, segment_rows=8)
+    reb_scores, reb_keys = open_engine(rebuilt, top_k=3, query_batch=4,
+                                       segment_rows=8).query(q)
+    assert np.array_equal(live_scores, reb_scores), report
+    assert np.array_equal(np.asarray(live_keys, dtype=str),
+                          np.asarray(reb_keys, dtype=str))
+
+
+@pytest.mark.fast
+def test_sigkill_mid_compaction_previous_snapshot_serves(tmp_path, rng_np,
+                                                         cpu_devices):
+    """Kill point 2: SIGKILL lands after the new manifest is written but
+    before the CURRENT flip. The previous snapshot keeps serving, the WAL
+    replays, the next compaction self-heals — and the final store is
+    query-equal to the rebuild."""
+    store = tmp_path / "store"
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, _rows(rng_np, 8), prefix="base", batch=4)
+        live.compact()                           # snapshot v1
+    _run_child(_CHILD_COMPACT, store, "compact_crash@seal=0")
+    # the commit point never happened: v1 still serves
+    assert snapshot_version(store) == 1
+    assert read_store_manifest(store)["total"] == 8
+    # the orphaned v2 manifest may exist — it must be ignored and later
+    # overwritten, never served
+    feats, keys, stats = load_wal_tail(store, embed_dim=DIM)
+    assert feats.shape[0] == 4                   # the acked crash-era rows
+    with _open_live_retry(store) as live:
+        assert live.snapshot == 1 and live.recovered_rows == 4
+        rep = live.compact()                     # self-heals: v2 for real
+        assert rep["snapshot"] == 2
+    assert read_store_manifest(store)["total"] == 12
+    rebuilt = tmp_path / "rebuilt"
+    assert _rebuild_over(store, rebuilt) == 12
+    q = _rows(rng_np, 4)
+    from dcr_tpu.search.shardindex import open_engine
+
+    live_scores, live_keys = query_live(store, q, top_k=2, segment_rows=8)
+    reb_scores, reb_keys = open_engine(rebuilt, top_k=2, query_batch=4,
+                                       segment_rows=8).query(q)
+    assert np.array_equal(live_scores, reb_scores)
+    assert np.array_equal(np.asarray(live_keys, dtype=str),
+                          np.asarray(reb_keys, dtype=str))
+
+
+@pytest.mark.fast
+def test_wal_torn_fault_rolls_segment_and_preserves_later_appends(
+        tmp_path, rng_np):
+    """The in-process wal_torn fault writes a torn frame WITHOUT acking;
+    the segment rolls so later appends stay recoverable."""
+    store = tmp_path / "store"
+    faults.install("wal_torn@append=1")
+    try:
+        with LiveStore.open(store, embed_dim=DIM) as live:
+            live.append(_rows(rng_np, 2), ["a", "b"])
+            with pytest.raises(StoreError, match="wal_torn"):
+                live.append(_rows(rng_np, 2), ["c", "d"])
+            live.append(_rows(rng_np, 2), ["e", "f"])
+    finally:
+        faults.clear()
+    with LiveStore.open(store) as live:
+        assert live.torn_segments == 1
+        feats, keys = live.tail()
+        assert list(keys) == ["a", "b", "e", "f"]    # torn rows never served
+
+
+# ---------------------------------------------------------------------------
+# 6. the serve ingest pump: bounded, never blocks, drops-and-counts
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_pump_offer_never_blocks_and_drops_when_full(tmp_path, rng_np):
+    from dcr_tpu.serve.ingest import IngestPump
+
+    store = tmp_path / "store"
+    # hold the lease so the pump can never open the store: the queue fills
+    blocker = StoreWriterLease(store, owner="blocker").acquire()
+    try:
+        pump = IngestPump(store, embed_dim=DIM, queue_max=4, batch_rows=2,
+                          lease_s=30.0).start()
+        before = _counter("ingest/dropped_total")
+        accepted = dropped = 0
+        t0 = time.perf_counter()
+        for i in range(32):
+            if pump.offer(_rows(rng_np, 1)[0], f"g{i}"):
+                accepted += 1
+            else:
+                dropped += 1
+        elapsed = time.perf_counter() - t0
+        assert accepted == 4 and dropped == 28
+        assert _counter("ingest/dropped_total") == before + 28
+        assert pump.dropped_rows == 28
+        assert elapsed < 1.0                     # 32 offers, zero blocking
+        deadline = time.monotonic() + 20
+        while pump.status != "waiting_lease" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert pump.status == "waiting_lease"
+        assert pump.stats()["queued"] == 4
+        pump.stop(timeout=5.0)
+    finally:
+        blocker.release()
+
+
+@pytest.mark.fast
+def test_pump_appends_compacts_and_fires_snapshot_callback(tmp_path, rng_np):
+    from dcr_tpu.serve.ingest import IngestPump
+
+    store = tmp_path / "store"
+    snapshots = []
+    with IngestPump(store, embed_dim=DIM, queue_max=64, batch_rows=4,
+                    compact_rows=8,
+                    on_snapshot=snapshots.append) as pump:
+        for i in range(16):
+            assert pump.offer(_rows(rng_np, 1)[0], f"g{i}")
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            s = pump.stats()
+            if s["appended_rows"] >= 16 and s.get("compactions", 0) >= 1:
+                break
+            time.sleep(0.05)
+        s = pump.stats()
+        assert s["appended_rows"] == 16 and s["compactions"] >= 1, s
+    assert snapshots and snapshots[0] >= 1
+    reader = EmbeddingStoreReader(store)
+    recovered = load_wal_tail(store, embed_dim=DIM)[0].shape[0]
+    assert reader.total + recovered == 16        # every acked row durable
+
+
+# ---------------------------------------------------------------------------
+# 7. CLI + bench + schema surfaces
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_cli_recover_and_compact(tmp_path, rng_np, capsys):
+    from dcr_tpu.cli import search as cli
+
+    store = tmp_path / "store"
+    with LiveStore.open(store, embed_dim=DIM) as live:
+        _fill(live, _rows(rng_np, 8), batch=4)
+    cli.main(["recover", f"--store_dir={store}"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["tail_rows"] == 8 and rep["snapshot"] == 0
+    cli.main(["compact", f"--store_dir={store}"])
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["compaction"]["snapshot"] == 1
+    assert read_store_manifest(store)["total"] == 8
+
+
+@pytest.mark.fast
+def test_banked_bench_ingest_schema():
+    from tools.bench_ingest import validate_result
+
+    banked = Path(__file__).parent.parent / "BENCH_INGEST.json"
+    assert banked.exists(), "BENCH_INGEST.json must be committed"
+    doc = json.loads(banked.read_text())
+    assert validate_result(doc) == []
+    assert doc["equality"] == {"scores_equal": True, "keys_equal": True}
+    assert doc["response_path"]["passed"] is True
+
+
+@pytest.mark.fast
+def test_trace_schema_and_report_know_ingest():
+    from tools import trace_report
+
+    schema = json.loads(
+        (Path(__file__).parent.parent / "tools" /
+         "trace_schema.json").read_text())
+    assert "ingest/" in schema["known_names"]["span_prefixes"]
+    for name in ("ingest/append", "ingest/compact", "ingest/recover"):
+        assert name in schema["known_names"]["spans"]
+    records = [
+        {"ph": "X", "name": "ingest/append", "id": 1, "ts": 1e6, "dur": 900.0,
+         "pid": 1, "tid": 1, "tname": "t", "args": {"rows": 16}},
+        {"ph": "X", "name": "ingest/compact", "id": 2, "ts": 2e6,
+         "dur": 5000.0, "pid": 1, "tid": 1, "tname": "t",
+         "args": {"rows": 16, "records": 4, "snapshot": 1}},
+        {"ph": "X", "name": "ingest/recover", "id": 3, "ts": 3e6,
+         "dur": 700.0, "pid": 1, "tid": 1, "tname": "t",
+         "args": {"rows": 4, "torn": 1, "segments": 2}},
+    ]
+    summary = trace_report.ingest_summary(records)
+    assert summary["append"]["rows"] == 16
+    assert summary["compactions"][0]["snapshot"] == 1
+    assert summary["recoveries"][0]["torn"] == 1
+    text = trace_report.render_text(
+        trace_report.summarize(records), [Path(".")])
+    assert "ingest:" in text and "snapshot v1" in text
+
+
+@pytest.mark.fast
+def test_ingest_metrics_have_required_prometheus_names(tmp_path, rng_np):
+    with LiveStore.open(tmp_path / "s", embed_dim=DIM) as live:
+        live.append(_rows(rng_np, 2), ["a", "b"])
+        live.compact()
+    text = tracing.registry().prometheus_text()
+    for metric in ("dcr_ingest_acked_total", "dcr_store_rows_total"):
+        assert metric in text, metric
+    # the full required surface resolves through the same sanitizer
+    assert tracing.sanitize_metric_name(
+        "ingest/lag_seconds") == "dcr_ingest_lag_seconds"
+    assert tracing.sanitize_metric_name(
+        "ingest/queue_depth") == "dcr_ingest_queue_depth"
+    for name in ("dropped", "recovered", "torn"):
+        assert tracing.sanitize_metric_name(
+            f"ingest/{name}_total") == f"dcr_ingest_{name}_total"
+
+
+# ---------------------------------------------------------------------------
+# 8. slow: the live-ingesting serve worker, crash-equivalent end to end
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serve_live_ingest_check_sees_new_generations_and_snapshots(
+        tmp_path, cpu_devices):
+    """In-process serve e2e: with ingest on, a generation streamed into the
+    store is findable by /check IMMEDIATELY (live tail), then still after
+    compaction publishes a new snapshot (engine refresh, no restart) — and
+    the check result equals the same check against a post-hoc rebuilt
+    store over the acked rows."""
+    from tests.test_risk import _png_b64, _risk_service, _tiny_stack
+    from tests.test_store import _embed_train_images
+    from dcr_tpu.core.config import IngestConfig, RiskConfig
+    from dcr_tpu.obs.copyrisk import CopyRiskIndex
+
+    stack = _tiny_stack()
+    plain = _risk_service(stack)
+    img_train = plain.submit("a red square", seed=1).future.result(timeout=300)
+    img_new = plain.submit("a blue circle", seed=2).future.result(timeout=300)
+    plain.stop(timeout=60)
+
+    store = tmp_path / "livestore"
+    writer = EmbeddingStoreWriter.create(store, shard_rows=4)
+    writer.add_dump(_embed_train_images(tmp_path, [img_train]))
+    writer.finalize()
+
+    ingest = IngestConfig(enabled=True, queue_max=64, batch_rows=1,
+                          seal_rows=8, compact_rows=2)
+    risk = RiskConfig(store_dir=str(store), image_size=32, threshold=0.999)
+    svc = _risk_service(stack, risk=risk, ingest=ingest)
+    try:
+        assert svc.wait_risk_ready(timeout=300) and svc.risk_status() == "ok"
+        req = svc.submit("a blue circle", seed=2)
+        out = np.asarray(req.future.result(timeout=300))
+        assert np.array_equal(out, img_new)      # ingest never perturbs
+        # the scored generation becomes durable + queryable without restart
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            stats = svc._pump.stats() if svc._pump else {}
+            if stats.get("appended_rows", 0) >= 1:
+                break
+            time.sleep(0.1)
+        assert stats.get("appended_rows", 0) >= 1, stats
+        check = svc.check({"image_png_b64": _png_b64(img_new)})
+        assert check["max_sim"] > 0.999
+        assert check["top_key"].startswith("gen/"), check
+        # drive past compact_rows: the snapshot advances and /check still
+        # answers from the refreshed engine — no restart, no duplicate rows
+        svc.submit("a red square", seed=3).future.result(timeout=300)
+        svc.submit("a blue circle", seed=4).future.result(timeout=300)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            stats = svc._pump.stats()
+            if stats.get("compactions", 0) >= 1 and stats.get(
+                    "appended_rows", 0) >= 3:
+                break
+            time.sleep(0.1)
+        assert stats.get("compactions", 0) >= 1, stats
+        check2 = svc.check({"image_png_b64": _png_b64(img_new)})
+        assert check2["max_sim"] > 0.999
+        assert check2["top_key"] == check["top_key"]
+        assert svc.health_doc()["ingest"]["status"] == "ok"
+    finally:
+        svc.stop(timeout=120)
+
+    # crash-equivalence of the final state: recover the live store and pin
+    # /check (score_batch) equal against a post-hoc rebuild over acked rows
+    with LiveStore.open(store) as live:
+        live.compact()
+    rebuilt = tmp_path / "rebuilt"
+    w = EmbeddingStoreWriter(rebuilt, embed_dim=512)
+    for feats, keys in EmbeddingStoreReader(store).iter_shards():
+        w.add(feats, [str(k) for k in keys])
+    w.finalize()
+    probe_live = CopyRiskIndex.load(
+        RiskConfig(store_dir=str(store), image_size=32), batch=4)
+    probe_reb = CopyRiskIndex.load(
+        RiskConfig(store_dir=str(rebuilt), image_size=32), batch=4)
+    s_live = probe_live.score_batch(img_new[None])[0]
+    s_reb = probe_reb.score_batch(img_new[None])[0]
+    assert s_live.max_sim == s_reb.max_sim
+    assert s_live.top_key == s_reb.top_key
+
+
+@pytest.mark.slow
+def test_serve_subprocess_sigkill_mid_ingest_recovers_equal(tmp_path,
+                                                            cpu_devices):
+    """The full chaos e2e over HTTP: a live-ingesting dcr-serve subprocess
+    is SIGKILLed MID-APPEND by the ingest_crash fault; a fresh incarnation
+    recovers the WAL (stale lease taken over, torn tail truncated) and
+    serves /check answers equal to a post-hoc rebuilt store over the acked
+    rows. Unacked rows may be lost; nothing is corrupted."""
+    import urllib.request
+
+    from tests.test_risk import _png_b64, _risk_service, _tiny_stack
+    from tests.test_store import _embed_train_images
+    from tests.test_serve import (_export_tiny_ckpt, _free_port, _get,
+                                  _serve_env)
+    from dcr_tpu.core.config import RiskConfig
+    from dcr_tpu.obs.copyrisk import CopyRiskIndex
+
+    stack = _tiny_stack()
+    plain = _risk_service(stack, max_batch=2)
+    img_train = plain.submit("a red square", seed=1).future.result(timeout=300)
+    img_probe = plain.submit("a blue circle", seed=2).future.result(
+        timeout=300)
+    plain.stop(timeout=60)
+    store = tmp_path / "livestore"
+    writer = EmbeddingStoreWriter.create(store, shard_rows=4)
+    writer.add_dump(_embed_train_images(tmp_path, [img_train]))
+    writer.finalize()
+
+    ckpt = _export_tiny_ckpt(tmp_path)
+    env, repo = _serve_env()
+
+    def serve_argv(port):
+        return [sys.executable, "-m", "dcr_tpu.cli.serve",
+                f"--model_path={ckpt}", f"--port={port}",
+                "--resolution=16", "--num_inference_steps=2",
+                "--sampler=ddim", "--max_batch=2", "--max_wait_ms=100",
+                "--queue_depth=16", "--request_timeout_s=300", "--seed=0",
+                f"--risk.store_dir={store}", "--risk.image_size=32",
+                "--risk.threshold=0.999", "--ingest.enabled=true",
+                "--ingest.batch_rows=1", "--ingest.compact_rows=0",
+                "--ingest.lease_s=3"]
+
+    def wait_risk_ok(proc, port, deadline_s=300):
+        deadline = time.monotonic() + deadline_s
+        while True:
+            try:
+                _, health = _get(port, "/healthz", timeout=2)
+                if health["status"] == "ok" and health["risk"] == "ok":
+                    return
+            except OSError:
+                pass
+            if proc.poll() is not None or time.monotonic() > deadline:
+                out = proc.stdout.read() if proc.stdout else ""
+                raise AssertionError(
+                    f"server not risk-ready (rc={proc.poll()}): {out[-3000:]}")
+            time.sleep(0.5)
+
+    def post_generate(port, prompt, seed):
+        body = json.dumps({"prompt": prompt, "seed": seed}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            return json.loads(resp.read())
+
+    # incarnation 1: the 2nd WAL append SIGKILLs the worker mid-frame
+    port = _free_port()
+    env1 = dict(env, DCR_FAULTS="ingest_crash@append=1")
+    proc = subprocess.Popen(serve_argv(port), env=env1, cwd=repo,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    killed_rc = None
+    try:
+        wait_risk_ok(proc, port)
+        for seed in (10, 11, 12):
+            try:
+                doc = post_generate(port, "a blue circle", seed)
+                assert doc.get("copy_risk") is not None
+            except OSError:
+                break                            # the SIGKILL landed
+        killed_rc = proc.wait(timeout=120)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+    assert killed_rc == -signal.SIGKILL, killed_rc
+
+    # the acked set survives on disk; the torn in-flight frame does not
+    acked_feats, acked_keys, stats = load_wal_tail(store, embed_dim=512)
+    assert acked_feats.shape[0] == 1, stats      # append 0 acked, 1 torn
+    assert stats["torn_segments"] >= 1
+    assert all(str(k).startswith("gen/") for k in acked_keys)
+
+    # post-hoc rebuild over committed + acked rows
+    rebuilt = tmp_path / "rebuilt"
+    w = EmbeddingStoreWriter(rebuilt, embed_dim=512)
+    for feats, keys in EmbeddingStoreReader(store).iter_shards():
+        w.add(feats, [str(k) for k in keys])
+    w.add(acked_feats, [str(k) for k in acked_keys])
+    w.finalize()
+
+    # incarnation 2: recovers (stale lease, torn tail) and serves /check
+    port2 = _free_port()
+    proc2 = subprocess.Popen(serve_argv(port2), env=env, cwd=repo,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+    try:
+        wait_risk_ok(proc2, port2)
+        deadline = time.monotonic() + 120
+        while True:                              # wait for WAL recovery
+            _, health = _get(port2, "/healthz", timeout=2)
+            if health.get("ingest", {}).get("status") == "ok":
+                break
+            assert time.monotonic() < deadline, health
+            time.sleep(0.5)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port2}/check",
+            data=json.dumps(
+                {"image_png_b64": _png_b64(img_probe)}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            recovered_check = json.loads(resp.read())
+    finally:
+        if proc2.poll() is None:
+            proc2.send_signal(signal.SIGTERM)
+            proc2.wait(timeout=120)
+
+    probe = CopyRiskIndex.load(
+        RiskConfig(store_dir=str(rebuilt), image_size=32), batch=4)
+    expect = probe.score_batch(img_probe[None])[0]
+    assert recovered_check["max_sim"] == pytest.approx(expect.max_sim,
+                                                       abs=1e-6)
+    assert recovered_check["top_key"] == expect.top_key

@@ -1,0 +1,348 @@
+"""Live-ingest bench: WAL append throughput, recovery cost, and the
+never-blocks response-path guarantee (dcr-live, ISSUE 16).
+
+Builds a synthetic SSCD-width stream and measures four things:
+
+- **append**: sustained :meth:`LiveStore.append` rate (each acked batch is
+  one sha256-framed WAL record + fsync) while a concurrent reader hammers
+  :func:`query_live` against the same store — ingest and queries share the
+  store in production, so they share it here;
+- **recovery**: time for :meth:`LiveStore.open` to replay the WAL as a
+  function of unfolded WAL size — the restart-latency curve that tells you
+  what ``compact_rows`` buys;
+- **equality**: a live store (committed snapshot + WAL tail) must answer
+  queries EXACTLY equal (scores and keys) to a one-shot rebuilt store over
+  the same rows — the crash-equivalence contract, asserted here on the
+  happy path (tests/test_livestore.py asserts it under SIGKILL);
+- **response path**: p99 of a simulated response-path critical section
+  with the ingest ``offer()`` hook on vs off. ``offer`` is a bounded
+  ``put_nowait`` — the added p99 must stay within noise
+  (``BENCH_INGEST_P99_SLACK_MS``, default 1.0 ms), asserted in BOTH modes:
+  a slow disk may throttle ingest coverage, never generation latency.
+
+Gate (full mode): append throughput must reach ``MIN_INGEST_ROWS_PER_S``
+(2000 rows/s) or exit 1. ``--smoke`` (CI): tiny stream; validates the JSON
+schema, the equality pin and the response-path bound; the throughput gate
+is recorded but not enforced (shared CI runners don't gate perf — the
+banked full run does). Results bank as BENCH_INGEST.json.
+
+Usage: python tools/bench_ingest.py [--smoke]
+Env knobs: BENCH_INGEST_ROWS (default 8192; smoke 512),
+BENCH_INGEST_BATCH (16), BENCH_INGEST_DIM (512; smoke 64),
+BENCH_INGEST_QUERIES (16), BENCH_INGEST_TOPK (4),
+BENCH_INGEST_TRIALS (2000; smoke 300), BENCH_INGEST_MIN (gate, 2000),
+BENCH_INGEST_P99_SLACK_MS (1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).resolve().parent.parent / "BENCH_INGEST.json"
+
+#: ISSUE 16 acceptance floor: acked (fsynced) append throughput.
+MIN_INGEST_ROWS_PER_S = 2000.0
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name) or default)
+
+
+def _percentile(sorted_vals, pct: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(len(sorted_vals) * pct / 100.0))
+    return sorted_vals[idx]
+
+
+def run_append(root: Path, rows_mat, keys, *, batch_rows: int,
+               top_k: int, queries) -> dict:
+    """Append the whole stream batch-by-batch while a reader thread runs
+    query_live loops against the same store (committed base + live tail)."""
+    import numpy as np
+
+    from dcr_tpu.search.livestore import LiveStore, query_live
+    from dcr_tpu.search.shardindex import open_engine
+
+    dim = rows_mat.shape[1]
+    store = root / "append_store"
+    # a committed base snapshot so the concurrent reader exercises the
+    # engine + tail merge, not just the tail-only fallback
+    with LiveStore.open(store, embed_dim=dim) as live:
+        live.append(rows_mat[:batch_rows], keys[:batch_rows])
+        live.compact()
+    engine = open_engine(store, top_k=top_k,
+                         query_batch=max(len(queries), 1))
+    stop = threading.Event()
+    query_laps = [0]
+
+    def reader():
+        while not stop.is_set():
+            query_live(store, queries, top_k=top_k, engine=engine)
+            query_laps[0] += 1
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    lat = []
+    appended = 0
+    try:
+        with LiveStore.open(store) as live:
+            t0 = time.perf_counter()
+            for start in range(batch_rows, rows_mat.shape[0], batch_rows):
+                chunk = rows_mat[start:start + batch_rows]
+                t1 = time.perf_counter()
+                live.append(chunk, keys[start:start + len(chunk)])
+                lat.append(time.perf_counter() - t1)
+                appended += len(chunk)
+            wall = time.perf_counter() - t0
+    finally:
+        stop.set()
+        t.join(timeout=10.0)
+    lat.sort()
+    return {"rows": appended, "seconds": round(wall, 4),
+            "rows_per_s": round(appended / max(wall, 1e-9)),
+            "p50_ms": round(_percentile(lat, 50) * 1e3, 4),
+            "p99_ms": round(_percentile(lat, 99) * 1e3, 4),
+            "concurrent_query_laps": int(query_laps[0])}
+
+
+def run_recovery_curve(root: Path, rows_mat, keys, *,
+                       batch_rows: int) -> list[dict]:
+    """LiveStore.open (replay) time vs unfolded WAL size."""
+    from dcr_tpu.search.livestore import LiveStore
+
+    total = rows_mat.shape[0]
+    curve = []
+    for frac_idx, wal_rows in enumerate(
+            sorted({max(batch_rows, total // 4), max(batch_rows, total // 2),
+                    total})):
+        store = root / f"recover_{frac_idx}"
+        with LiveStore.open(store, embed_dim=rows_mat.shape[1]) as live:
+            for start in range(0, wal_rows, batch_rows):
+                chunk = rows_mat[start:start + batch_rows]
+                live.append(chunk, keys[start:start + len(chunk)])
+        t0 = time.perf_counter()
+        with LiveStore.open(store) as live:
+            recovered = live.recovered_rows
+        curve.append({"wal_rows": int(wal_rows),
+                      "recovered_rows": int(recovered),
+                      "seconds": round(time.perf_counter() - t0, 4)})
+    return curve
+
+
+def run_equality(root: Path, rows_mat, keys, *, batch_rows: int,
+                 top_k: int, queries) -> dict:
+    """Live store (committed + WAL tail) vs one-shot rebuilt store: scores
+    and keys must be EXACTLY equal."""
+    import numpy as np
+
+    from dcr_tpu.search.livestore import LiveStore, query_live
+    from dcr_tpu.search.shardindex import open_engine
+    from dcr_tpu.search.store import EmbeddingStoreWriter
+
+    dim = rows_mat.shape[1]
+    half = (rows_mat.shape[0] // 2 // batch_rows) * batch_rows
+    live_dir = root / "eq_live"
+    segment_rows = max(top_k, 256)
+    with LiveStore.open(live_dir, embed_dim=dim) as live:
+        for start in range(0, half, batch_rows):
+            live.append(rows_mat[start:start + batch_rows],
+                        keys[start:start + batch_rows])
+        live.compact()
+        for start in range(half, rows_mat.shape[0], batch_rows):
+            chunk = rows_mat[start:start + batch_rows]
+            live.append(chunk, keys[start:start + len(chunk)])
+    rebuilt_dir = root / "eq_rebuilt"
+    w = EmbeddingStoreWriter(rebuilt_dir, embed_dim=dim)
+    w.add(rows_mat, keys)
+    w.finalize()
+    live_scores, live_keys = query_live(live_dir, queries, top_k=top_k,
+                                        segment_rows=segment_rows)
+    engine = open_engine(rebuilt_dir, top_k=top_k,
+                         query_batch=max(len(queries), 1),
+                         segment_rows=segment_rows)
+    reb_scores, reb_keys = engine.query(queries)
+    return {"scores_equal": bool(np.array_equal(live_scores, reb_scores)),
+            "keys_equal": bool(np.array_equal(
+                np.asarray(live_keys, dtype=str),
+                np.asarray(reb_keys, dtype=str)))}
+
+
+def run_response_path(root: Path, *, dim: int, trials: int,
+                      slack_ms: float) -> dict:
+    """p99 of a simulated response-path critical section, ingest hook off
+    vs on. The hook is one bounded ``offer()`` — a full queue drops, so
+    the added p99 must be noise-level regardless of appender speed."""
+    import numpy as np
+
+    from dcr_tpu.serve.ingest import IngestPump
+
+    rng = np.random.default_rng(3)
+    row = rng.standard_normal((dim,)).astype(np.float32)
+    a = rng.standard_normal((32, 32)).astype(np.float32)
+
+    def workload():
+        # a stand-in for the post-sample host work a response already does
+        return float(np.dot(a, a).sum())
+
+    def leg(pump) -> list[float]:
+        lat = []
+        for i in range(trials):
+            t0 = time.perf_counter()
+            workload()
+            if pump is not None:
+                pump.offer(row, f"bench/{i}")
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat
+
+    off = leg(None)
+    with IngestPump(root / "p99_store", embed_dim=dim, queue_max=256,
+                    batch_rows=16) as pump:
+        # let the appender take the lease before timing starts
+        deadline = time.monotonic() + 10.0
+        while pump.status == "starting" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        on = leg(pump)
+    p99_off = _percentile(off, 99) * 1e3
+    p99_on = _percentile(on, 99) * 1e3
+    added = p99_on - p99_off
+    return {"trials": trials,
+            "p99_off_ms": round(p99_off, 4), "p99_on_ms": round(p99_on, 4),
+            "added_p99_ms": round(added, 4),
+            "slack_ms": slack_ms,
+            "dropped_rows": int(pump.dropped_rows),
+            "appended_rows": int(pump.appended_rows),
+            "passed": bool(added <= slack_ms)}
+
+
+def validate_result(doc: dict) -> list[str]:
+    """Schema problems with a BENCH_INGEST document ([] = valid). Used by
+    the --smoke leg and tests/test_livestore.py."""
+    problems: list[str] = []
+
+    def need(obj, field, types, where):
+        v = obj.get(field)
+        if not isinstance(v, types) or isinstance(v, bool) and types != bool:
+            problems.append(f"{where}.{field}: missing/wrong type")
+            return None
+        return v
+
+    need(doc, "version", int, "$")
+    cfg = need(doc, "config", dict, "$") or {}
+    for f in ("rows", "batch_rows", "embed_dim", "queries", "top_k",
+              "trials"):
+        need(cfg, f, int, "$.config")
+    ap = need(doc, "append", dict, "$") or {}
+    for f in ("rows", "seconds", "rows_per_s", "p50_ms", "p99_ms"):
+        need(ap, f, (int, float), "$.append")
+    need(ap, "concurrent_query_laps", int, "$.append")
+    curve = need(doc, "recovery", list, "$") or []
+    if not curve:
+        problems.append("$.recovery: empty")
+    for i, pt in enumerate(curve):
+        for f in ("wal_rows", "recovered_rows"):
+            need(pt, f, int, f"$.recovery[{i}]")
+        need(pt, "seconds", (int, float), f"$.recovery[{i}]")
+    eq = need(doc, "equality", dict, "$") or {}
+    for f in ("scores_equal", "keys_equal"):
+        if not isinstance(eq.get(f), bool):
+            problems.append(f"$.equality.{f}: missing/not bool")
+    rp = need(doc, "response_path", dict, "$") or {}
+    for f in ("p99_off_ms", "p99_on_ms", "added_p99_ms", "slack_ms"):
+        need(rp, f, (int, float), "$.response_path")
+    if not isinstance(rp.get("passed"), bool):
+        problems.append("$.response_path.passed: missing/not bool")
+    gate = need(doc, "gate", dict, "$") or {}
+    need(gate, "min_rows_per_s", (int, float), "$.gate")
+    need(gate, "rows_per_s", (int, float), "$.gate")
+    need(gate, "enforced", bool, "$.gate")
+    if not isinstance(gate.get("passed"), bool):
+        problems.append("$.gate.passed: missing/not bool")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+
+    import numpy as np
+
+    rows = _env_int("BENCH_INGEST_ROWS", 512 if smoke else 8192)
+    batch_rows = _env_int("BENCH_INGEST_BATCH", 16)
+    dim = _env_int("BENCH_INGEST_DIM", 64 if smoke else 512)
+    n_queries = _env_int("BENCH_INGEST_QUERIES", 16)
+    top_k = _env_int("BENCH_INGEST_TOPK", 4)
+    trials = _env_int("BENCH_INGEST_TRIALS", 300 if smoke else 2000)
+    min_rps = float(os.environ.get("BENCH_INGEST_MIN")
+                    or MIN_INGEST_ROWS_PER_S)
+    slack_ms = float(os.environ.get("BENCH_INGEST_P99_SLACK_MS") or 1.0)
+    print(f"bench_ingest{' --smoke' if smoke else ''}: stream {rows}x{dim} "
+          f"in batches of {batch_rows}, {n_queries} concurrent queries, "
+          f"top_k={top_k}")
+
+    rng = np.random.default_rng(2)
+    rows_mat = rng.standard_normal((rows, dim)).astype(np.float32)
+    keys = [f"gen/{i:06d}" for i in range(rows)]
+    queries = rng.standard_normal((n_queries, dim)).astype(np.float32)
+
+    with tempfile.TemporaryDirectory(prefix="bench_ingest_") as td:
+        root = Path(td)
+        append = run_append(root, rows_mat, keys, batch_rows=batch_rows,
+                            top_k=top_k, queries=queries)
+        recovery = run_recovery_curve(root, rows_mat, keys,
+                                      batch_rows=batch_rows)
+        equality = run_equality(root, rows_mat, keys, batch_rows=batch_rows,
+                                top_k=top_k, queries=queries)
+        response = run_response_path(root, dim=dim, trials=trials,
+                                     slack_ms=slack_ms)
+
+    doc = {
+        "version": 1,
+        "config": {"rows": rows, "batch_rows": batch_rows, "embed_dim": dim,
+                   "queries": n_queries, "top_k": top_k, "trials": trials},
+        "append": append,
+        "recovery": recovery,
+        "equality": equality,
+        "response_path": response,
+        "gate": {"min_rows_per_s": min_rps,
+                 "rows_per_s": append["rows_per_s"],
+                 "enforced": not smoke,
+                 "passed": bool(append["rows_per_s"] >= min_rps)},
+    }
+
+    problems = validate_result(doc)
+    OUT.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    print(f"bench_ingest: {append['rows_per_s']} rows/s acked "
+          f"(p99 {append['p99_ms']} ms/append, "
+          f"{append['concurrent_query_laps']} concurrent query laps), "
+          f"response-path p99 +{response['added_p99_ms']} ms -> {OUT}")
+    if problems:
+        print("bench_ingest: SCHEMA problems:\n  " + "\n  ".join(problems))
+        return 1
+    if not (equality["scores_equal"] and equality["keys_equal"]):
+        print("bench_ingest: EQUALITY FAILED — live store results differ "
+              f"from the rebuilt store ({equality})")
+        return 1
+    if not response["passed"]:
+        print(f"bench_ingest: RESPONSE-PATH GATE FAILED — ingest added "
+              f"{response['added_p99_ms']} ms to p99 "
+              f"(> {slack_ms} ms slack)")
+        return 1
+    if not smoke and not doc["gate"]["passed"]:
+        print(f"bench_ingest: GATE FAILED — {append['rows_per_s']} rows/s "
+              f"< {min_rps}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -243,6 +243,7 @@ _CATEGORIES = (
     ("train/", "train"),
     ("risk/", "risk"),
     ("search/", "search"),
+    ("ingest/", "ingest"),
 )
 
 
@@ -434,6 +435,61 @@ def search_summary(records: list[dict]) -> dict | None:
             "rows": sum(int(r["args"].get("rows", 0)) for r in ingest),
             "total_ms": round(sum(r["dur"] for r in ingest) / 1e3, 3),
         }
+    return out
+
+
+def _fmt_ts(ts_us: float) -> str:
+    return time.strftime("%H:%M:%S", time.localtime(ts_us / 1e6))
+
+
+def ingest_summary(records: list[dict]) -> dict | None:
+    """The "Ingest" section (dcr-live): streaming-provenance health.
+
+    Built from the ``ingest/append`` spans (WAL append throughput + fsync
+    latency percentiles), the ``ingest/compact`` spans (the compaction
+    timeline: rows folded, snapshot published, duration), and the
+    ``ingest/recover`` spans + ``ingest/recovered`` events (what a restart
+    replayed, how many torn tails it truncated). None when nothing
+    ingested — other traces keep their shape.
+    """
+    appends = [r for r in records
+               if r["ph"] == "X" and r["name"] == "ingest/append"]
+    compacts = [r for r in records
+                if r["ph"] == "X" and r["name"] == "ingest/compact"]
+    recovers = [r for r in records
+                if r["ph"] == "X" and r["name"] == "ingest/recover"]
+    if not appends and not compacts and not recovers:
+        return None
+    out: dict = {}
+    if appends:
+        durs = sorted(r["dur"] / 1e3 for r in appends)
+        rows = sum(int(r["args"].get("rows", 0)) for r in appends)
+        wall_s = (max(r["ts"] + r["dur"] for r in appends)
+                  - min(r["ts"] for r in appends)) / 1e6
+        out["append"] = {
+            "records": len(appends),
+            "rows": rows,
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50), 3),
+            "p99_ms": round(_percentile(durs, 99), 3),
+            "rows_per_s": round(rows / max(wall_s, 1e-9)),
+        }
+    if compacts:
+        out["compactions"] = [
+            {"time": _fmt_ts(r["ts"]),
+             "rows": int(r["args"].get("rows", 0)),
+             "records": int(r["args"].get("records", 0)),
+             "snapshot": r["args"].get("snapshot"),
+             "ms": round(r["dur"] / 1e3, 3)}
+            for r in sorted(compacts, key=lambda r: r["ts"])][:50]
+    if recovers:
+        out["recoveries"] = [
+            {"time": _fmt_ts(r["ts"]),
+             "rows": int(r["args"].get("rows", 0)),
+             "torn": int(r["args"].get("torn", 0)),
+             "segments": int(r["args"].get("segments", 0)),
+             "ms": round(r["dur"] / 1e3, 3)}
+            for r in sorted(recovers, key=lambda r: r["ts"])][:50]
     return out
 
 
@@ -699,6 +755,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "compiles_per_incarnation": compiles_per_incarnation(records),
         "copy_risk": copy_risk_summary(records),
         "search": search_summary(records),
+        "ingest": ingest_summary(records),
         "fast_sampling": fast_sampling_summary(records),
         "pipeline": pipeline_summary(records),
         "memory": memory_summary(records),
@@ -849,6 +906,25 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
             lines.append(
                 f"  ingest: {ing['shards']} shard(s), {ing['rows']} rows in "
                 f"{ing['total_ms']} ms")
+    ing = summary.get("ingest")
+    if ing:
+        lines.append("\ningest:")
+        ap = ing.get("append")
+        if ap:
+            lines.append(
+                f"  append: {ap['records']} record(s), {ap['rows']} rows "
+                f"({ap['rows_per_s']} rows/s)  p50 {ap['p50_ms']} ms  "
+                f"p99 {ap['p99_ms']} ms")
+        for c in ing.get("compactions", []):
+            lines.append(
+                f"  {c['time']} compacted {c['rows']} rows "
+                f"({c['records']} record(s)) -> snapshot v{c['snapshot']} "
+                f"in {c['ms']} ms")
+        for rec in ing.get("recoveries", []):
+            lines.append(
+                f"  {rec['time']} recovered {rec['rows']} rows from "
+                f"{rec['segments']} segment(s), {rec['torn']} torn tail(s) "
+                f"truncated, in {rec['ms']} ms")
     risk = summary.get("copy_risk")
     if risk:
         lines.append(f"\ncopy risk: {risk['scored']} generation(s) scored, "
