@@ -113,6 +113,7 @@ def _run_supervisor(cfg: ServeConfig) -> None:
                     pending=sup.journal.pending_count())
     httpd.shutdown()
     httpd.server_close()       # joins handler threads: responses are on the wire
+    server_thread.join(timeout=5.0)   # serve_forever returned on shutdown()
     sup.shutdown()
     # re-read: a fleet can fail DURING the drain (every slot exhausting its
     # respawn budget while we wait) — the pre-drain snapshot alone would
@@ -190,6 +191,7 @@ def _run_worker(cfg: ServeConfig) -> None:
 
     heartbeat = None
     lease = None
+    risk_lease_thread = None
     if index >= 0:
         from dcr_tpu.serve.fleet import (LeaseHeartbeat, WorkerLease,
                                          fleet_paths, write_lease)
@@ -246,8 +248,9 @@ def _run_worker(cfg: ServeConfig) -> None:
             log.info("fleet worker %d risk index: %s", index,
                      service.risk_status())
 
-        threading.Thread(target=_sync_risk_lease, daemon=True,
-                         name="risk-lease-sync").start()
+        risk_lease_thread = threading.Thread(
+            target=_sync_risk_lease, daemon=True, name="risk-lease-sync")
+        risk_lease_thread.start()
     # unbounded BY DESIGN: the main thread's only job is to sleep until the
     # signal handler fires — there is no peer or producer that could wedge
     # this wait, and any deadline would just turn an idle server into a
@@ -265,6 +268,9 @@ def _run_worker(cfg: ServeConfig) -> None:
         R.log_event("serve_drain_incomplete", queued=service.queue.depth())
     httpd.shutdown()
     httpd.server_close()       # joins handler threads: responses are on the wire
+    server_thread.join(timeout=5.0)   # serve_forever returned on shutdown()
+    if risk_lease_thread is not None:
+        risk_lease_thread.join(timeout=2.0)   # exits once drained is set
     if heartbeat is not None:
         heartbeat.stop()
     if writer is not None:

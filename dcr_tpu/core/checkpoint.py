@@ -39,6 +39,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from dcr_tpu.core import dist
+from dcr_tpu.core import fsio
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 
@@ -193,6 +194,10 @@ class CheckpointManager:
                     shutil.rmtree(tmp)
                 tmp.mkdir(parents=True)
                 np.savez(tmp / "state.npz", **arrays)
+                # np.savez closed the file but its blocks may still be
+                # page-cache-only: fsync file + dir before the atomic commit
+                fsio.fsync_file(tmp / "state.npz")
+                fsio.fsync_dir(tmp)
                 tmp.replace(self._dir / str(step))  # atomic commit
                 # retention, oldest first (matches orbax max_to_keep)
                 steps = self._npz_steps()
@@ -264,8 +269,8 @@ class CheckpointManager:
         self._manifest_dir.mkdir(parents=True, exist_ok=True)
         manifest = {"step": step, **state_manifest(state)}
         tmp = self._manifest_path(step).with_suffix(".tmp")
-        tmp.write_text(json.dumps(manifest, sort_keys=True))
-        tmp.replace(self._manifest_path(step))
+        fsio.publish_durable(tmp, self._manifest_path(step),
+                             json.dumps(manifest, sort_keys=True))
 
     def _load_manifest(self, step: int) -> Optional[dict]:
         path = self._manifest_path(step)

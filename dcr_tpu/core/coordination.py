@@ -290,7 +290,9 @@ def hang_abort(name: str, *, coordinator: Optional[Coordinator] = None,
             # another thread (watchdog vs. collective timeout) is already
             # finishing the abort; park forever rather than racing it
             while True:  # pragma: no cover - parked until _exit
-                time.sleep(60)
+                # deliberate sleep-under-lock: holding _abort_guard forever
+                # IS the mechanism that serializes racing aborters
+                time.sleep(60)  # dcr-lint: disable=DCR013
         _abort_started = True
     coordinator = coordinator or _active_coordinator
     last = coordinator.last_agreement if coordinator is not None else None

@@ -1,0 +1,62 @@
+"""Durable small-file publishes.
+
+The repo's atomic-publish idiom is write-to-temp + ``os.replace``: a
+reader never observes a torn file *name*. But the rename is atomic in the
+namespace only — it says nothing about the data blocks, so a power cut
+shortly after the rename can leave a committed name pointing at torn
+bytes (the DCR014 torn-publish hazard). These helpers close that gap:
+the temp file is flushed and fsynced before the rename, and callers whose
+commit point depends on ordering against *other* files (a manifest naming
+shards, a CURRENT pointer naming a manifest) additionally fsync the
+directory so the rename itself is durable.
+
+Kept dependency-free (os + pathlib only): it is imported from the data
+path, the search store, checkpointing and the fleet control plane.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def fsync_file(path: str | Path) -> None:
+    """fsync an already-written file by path (e.g. after ``np.savez``
+    closed it — the bytes may still be page-cache-only)."""
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def fsync_dir(path: str | Path) -> None:
+    """Best-effort directory fsync: makes a completed rename durable.
+    Silently a no-op where directories cannot be opened (non-POSIX)."""
+    try:
+        fd = os.open(str(path), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def publish_durable(tmp: str | Path, target: str | Path,
+                    data: bytes | str, *, sync_dir: bool = False) -> None:
+    """Write ``data`` to ``tmp``, flush + fsync it, then atomically rename
+    over ``target``. With ``sync_dir=True`` the parent directory is fsynced
+    after the rename — required when a later write (manifest, CURRENT
+    pointer) must never become durable before this one."""
+    tmp, target = Path(tmp), Path(target)
+    payload = data.encode("utf-8") if isinstance(data, str) else data
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, target)
+    if sync_dir:
+        fsync_dir(target.parent)

@@ -45,6 +45,7 @@ from typing import Optional
 
 import numpy as np
 
+from dcr_tpu.core import fsio
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 from dcr_tpu.core.warmcache import quarantine_rename
@@ -161,8 +162,7 @@ class LatentCacheWriter:
         name = f"shard_{len(self._shards):05d}.npz"
         path = self.dir / name
         tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
-        tmp.write_bytes(blob)
-        os.replace(tmp, path)
+        fsio.publish_durable(tmp, path, blob)
         self._shards.append({"file": name, "sha256": _sha(blob),
                              "count": int(take)})
         self._total += take
@@ -179,8 +179,11 @@ class LatentCacheWriter:
                "shards": self._shards}
         path = self.dir / MANIFEST_NAME
         tmp = path.with_name(f"{MANIFEST_NAME}.tmp.{os.getpid()}")
-        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
-        os.replace(tmp, path)
+        # dir fsync: the manifest names the shards, so its rename must not
+        # become durable while a shard's own rename is still volatile
+        fsio.publish_durable(tmp, path,
+                             json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                             sync_dir=True)
         tracing.event("latentcache/finalized", shards=len(self._shards),
                       rows=self._total)
         return path

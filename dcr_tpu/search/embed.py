@@ -28,6 +28,7 @@ import jax
 import numpy as np
 from PIL import Image
 
+from dcr_tpu.core import fsio
 from dcr_tpu.core.config import SearchConfig
 from dcr_tpu.eval.features import (
     IMAGENET_NORM,
@@ -141,15 +142,15 @@ def save_embeddings(path: str | Path, features: np.ndarray,
     np.savez_compressed(buf, features=features, indexes=np.asarray(indexes))
     blob = buf.getvalue()
     tmp = path.with_name(f"{path.name}.tmp.{os.getpid()}")
-    tmp.write_bytes(blob)
-    os.replace(tmp, path)
+    fsio.publish_durable(tmp, path, blob)
     side = _sidecar_path(path)
     side_tmp = side.with_name(f"{side.name}.tmp.{os.getpid()}")
-    side_tmp.write_text(json.dumps(
+    # dir fsync after the sidecar: the sha sidecar condemns any dump it
+    # mismatches, so it must never survive a crash that lost the dump
+    fsio.publish_durable(side_tmp, side, json.dumps(
         {"sha256": hashlib.sha256(blob).hexdigest(),
          "rows": int(features.shape[0]), "bytes": len(blob)},
-        sort_keys=True) + "\n")
-    os.replace(side_tmp, side)
+        sort_keys=True) + "\n", sync_dir=True)
     return path
 
 

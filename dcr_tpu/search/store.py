@@ -70,6 +70,7 @@ from typing import Callable, Iterator, Optional, Sequence
 
 import numpy as np
 
+from dcr_tpu.core import fsio
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
 from dcr_tpu.core.warmcache import quarantine_rename
@@ -193,8 +194,8 @@ class StoreWriterLease:
                "renewed_at": time.time()}
         tmp = self.path.with_name(
             f"{LEASE_NAME}.tmp.{os.getpid()}.{threading.get_ident()}")
-        tmp.write_text(json.dumps(doc, sort_keys=True) + "\n")
-        os.replace(tmp, self.path)
+        fsio.publish_durable(tmp, self.path,
+                             json.dumps(doc, sort_keys=True) + "\n")
 
     def acquire(self) -> "StoreWriterLease":
         """Take the lease or raise :class:`StoreLeaseHeldError`."""
@@ -432,8 +433,7 @@ class EmbeddingStoreWriter:
         tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
         with tracing.span("search/ingest", shard=name, rows=int(take),
                           bytes=len(blob)):
-            tmp.write_bytes(blob)
-            os.replace(tmp, path)
+            fsio.publish_durable(tmp, path, blob)
         self._shards.append({"file": name, "sha256": _sha(blob),
                              "count": int(take)})
         self._total += take
@@ -472,15 +472,18 @@ class EmbeddingStoreWriter:
         name = versioned_manifest_name(snapshot) if live else MANIFEST_NAME
         path = self.dir / name
         tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
-        tmp.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
-        os.replace(tmp, path)
+        # dir fsync: the CURRENT flip below is the commit point — the
+        # manifest it names (and the shards the manifest names) must be
+        # durable strictly before the flip itself can be
+        fsio.publish_durable(tmp, path,
+                             json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                             sync_dir=True)
         if live:
             if _pre_current is not None:
                 _pre_current()
             cur = self.dir / CURRENT_NAME
             ctmp = cur.with_name(f"{CURRENT_NAME}.tmp.{os.getpid()}")
-            ctmp.write_text(name + "\n")
-            os.replace(ctmp, cur)
+            fsio.publish_durable(ctmp, cur, name + "\n", sync_dir=True)
         tracing.event("search/store_finalized", shards=len(self._shards),
                       rows=self._total, snapshot=snapshot)
         tracing.registry().gauge("search/store_rows").set(self._total)

@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Optional
 
+from dcr_tpu.core import fsio
 from dcr_tpu.core import resilience as R
 from dcr_tpu.serve.queue import GenBucket, Request
 
@@ -139,8 +140,8 @@ def write_lease(paths: FleetPaths, lease: WorkerLease) -> Path:
     target = paths.lease_file(lease.index)
     tmp = target.with_suffix(
         f".tmp.{lease.pid}.{threading.get_ident()}")
-    tmp.write_text(json.dumps(vars(lease), sort_keys=True) + "\n")
-    os.replace(tmp, target)
+    fsio.publish_durable(tmp, target,
+                         json.dumps(vars(lease), sort_keys=True) + "\n")
     return target
 
 

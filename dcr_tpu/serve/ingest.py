@@ -64,6 +64,10 @@ class IngestPump:
         self._stop = threading.Event()
         self._live: Optional[LiveStore] = None
         self._thread: Optional[threading.Thread] = None
+        # guards the appender-thread-written telemetry below (status,
+        # counters, last_error, _live) against the stats()/compact_now()
+        # readers; the offer() hot path never takes it
+        self._stats_lock = threading.Lock()
         self.status = "starting"
         self.appended_rows = 0
         self.dropped_rows = 0
@@ -100,19 +104,22 @@ class IngestPump:
                 live = LiveStore.open(self.dir, embed_dim=self.embed_dim,
                                       seal_rows=self.seal_rows,
                                       lease_s=self.lease_s, owner=self.owner)
-                self.status = "ok"
+                with self._stats_lock:
+                    self.status = "ok"
                 return live
             except StoreLeaseHeldError as e:
                 # another writer (likely our crashed predecessor) still
                 # holds the lease — wait out its heartbeat, then take over
-                self.status = "waiting_lease"
-                self.last_error = str(e)
+                with self._stats_lock:
+                    self.status = "waiting_lease"
+                    self.last_error = str(e)
                 tracing.registry().counter(
                     "ingest/lease_wait_total").inc()
                 self._stop.wait(max(0.5, self.lease_s / 4))
             except StoreError as e:
-                self.status = "error"
-                self.last_error = str(e)
+                with self._stats_lock:
+                    self.status = "error"
+                    self.last_error = str(e)
                 log.error("ingest: cannot open live store %s: %s",
                           self.dir, e)
                 return None
@@ -133,7 +140,8 @@ class IngestPump:
         live = self._open_with_retry()
         if live is None:
             return
-        self._live = live
+        with self._stats_lock:
+            self._live = live
         reg = tracing.registry()
         try:
             while True:
@@ -148,11 +156,13 @@ class IngestPump:
                 oldest_ts, feats, keys = self._drain_batch(first)
                 try:
                     live.append(feats, keys)
-                    self.appended_rows += feats.shape[0]
+                    with self._stats_lock:
+                        self.appended_rows += feats.shape[0]
                 except StoreError as e:
                     # includes the injected wal_torn frame: not acked, the
                     # batch is lost-and-counted, the pump keeps pumping
-                    self.last_error = str(e)
+                    with self._stats_lock:
+                        self.last_error = str(e)
                     reg.counter("ingest/append_failed_total").inc(
                         feats.shape[0])
                     log.warning("ingest: append failed (%d rows): %s",
@@ -165,19 +175,23 @@ class IngestPump:
                         >= self.compact_rows):
                     self._compact(live)
         finally:
-            self._live = None
+            with self._stats_lock:
+                self._live = None
             live.close()
-            if self.status == "ok":
-                self.status = "stopped"
+            with self._stats_lock:
+                if self.status == "ok":
+                    self.status = "stopped"
 
     def _compact(self, live: LiveStore) -> None:
         try:
             report = live.compact(prune=False)
         except StoreError as e:
-            self.last_error = str(e)
+            with self._stats_lock:
+                self.last_error = str(e)
             log.error("ingest: compaction failed: %s", e)
             return
-        self.compactions += 1
+        with self._stats_lock:
+            self.compactions += 1
         if self.on_snapshot is not None:
             try:
                 # the worker swaps its risk engine onto the new snapshot
@@ -195,18 +209,21 @@ class IngestPump:
         """Test/ops hook: force a compaction from the appender's context by
         lowering the threshold to the next append. Synchronous version for
         a quiesced pump."""
-        live = self._live
+        with self._stats_lock:
+            live = self._live
         if live is not None:
             self._compact(live)
 
     def stats(self) -> dict:
-        live = self._live
-        doc = {"status": self.status, "queued": self._q.qsize(),
-               "appended_rows": self.appended_rows,
-               "dropped_rows": self.dropped_rows,
-               "compactions": self.compactions}
-        if self.last_error:
-            doc["last_error"] = self.last_error
+        with self._stats_lock:
+            live = self._live
+            doc = {"status": self.status, "queued": self._q.qsize(),
+                   "appended_rows": self.appended_rows,
+                   "dropped_rows": self.dropped_rows,
+                   "compactions": self.compactions}
+            last_error = self.last_error
+        if last_error:
+            doc["last_error"] = last_error
         if live is not None:
             doc.update(snapshot=live.snapshot, total_rows=live.total_rows,
                        tail_rows=live.tail_rows)
@@ -215,7 +232,8 @@ class IngestPump:
     def tail(self, after_seq: int) -> tuple[np.ndarray, np.ndarray]:
         """Live-tail provider for :class:`CopyRiskIndex` — the acked rows
         newer than the caller's snapshot (empty until the store is open)."""
-        live = self._live
+        with self._stats_lock:
+            live = self._live
         if live is None:
             return (np.zeros((0, self.embed_dim), np.float32),
                     np.zeros((0,), dtype=object))

@@ -178,7 +178,9 @@ class DispatchChannel:
         self.index = slot.index
         self.port = lease.port
         self._stop = threading.Event()
-        self._dead = False                   # set (pre-stop) on worker death
+        # Event, not a bare bool: set by the monitor thread, read by the
+        # dispatch loop — no shared lock covers the pair
+        self._dead = threading.Event()       # set (pre-stop) on worker death
         cfg = supervisor.cfg
         self._batcher = Batcher(cfg.max_batch, cfg.max_wait_ms / 1000.0)
         self._thread = threading.Thread(
@@ -189,7 +191,7 @@ class DispatchChannel:
         self._thread.start()
 
     def mark_dead(self) -> None:
-        self._dead = True
+        self._dead.set()
         self._stop.set()
 
     def stop(self) -> None:
@@ -210,7 +212,7 @@ class DispatchChannel:
                 batch = self._batcher.next_batch(sup.queue, stop=self._stop)
                 if batch is None:
                     break
-                if self._dead:
+                if self._dead.is_set():
                     # stop() raced the take: nothing was dispatched, so this
                     # is a plain reinsertion (journal state is still QUEUED)
                     sup.queue.requeue(batch)
@@ -343,7 +345,9 @@ class FleetSupervisor:
         # lease so admission can open and queue early, but a balancer must
         # not see "ok" while nothing can serve yet
         self._ever_ready = False
-        self._draining = False
+        # Event, not a bare bool: set by the front end's drain path, read
+        # by admission and the monitor loop on their own threads
+        self._draining = threading.Event()
         self._fatal = threading.Event()
         self._shutdown = threading.Event()
         self._lock = threading.Lock()             # slot state transitions
@@ -377,7 +381,9 @@ class FleetSupervisor:
     def _spawn(self, slot: _WorkerSlot) -> None:
         f = self.cfg.fleet
         clear_lease(self.paths, slot.index)   # a stale lease must never join
-        slot.incarnation += 1
+        with self._lock:
+            slot.incarnation += 1
+            incarnation = slot.incarnation
         argv = [sys.executable, "-m", "dcr_tpu.cli.serve",
                 f"--config={self.paths.config}",
                 "--fleet.workers=0",
@@ -393,18 +399,22 @@ class FleetSupervisor:
         env.setdefault("DCR_FLIGHTREC_DIR", str(self.paths.root))
         try:
             with open(self.paths.worker_log(slot.index), "ab") as logf:
-                slot.proc = subprocess.Popen(argv, stdout=logf,
-                                             stderr=subprocess.STDOUT, env=env)
+                # Popen itself runs outside the lock (fork/exec is slow);
+                # only the slot-state publish is guarded
+                proc = subprocess.Popen(argv, stdout=logf,
+                                        stderr=subprocess.STDOUT, env=env)
         except OSError as e:
             R.log_event("fleet_spawn_error", worker=slot.index, error=repr(e))
             R.bump_counter("fleet_spawn_errors")
             self._spawn_failed(slot, f"spawn: {e!r}")
             return
-        slot.state = SPAWNING
-        slot.spawn_deadline = time.time() + f.spawn_timeout_s
+        with self._lock:
+            slot.proc = proc
+            slot.state = SPAWNING
+            slot.spawn_deadline = time.time() + f.spawn_timeout_s
         self.counter("workers_spawned").inc()
-        R.log_trace("fleet_spawn", worker=slot.index, pid=slot.proc.pid,
-                    incarnation=slot.incarnation)
+        R.log_trace("fleet_spawn", worker=slot.index, pid=proc.pid,
+                    incarnation=incarnation)
 
     def _worker_joined(self, slot: _WorkerSlot, lease: WorkerLease) -> None:
         with self._lock:
@@ -450,20 +460,22 @@ class FleetSupervisor:
         with self._lock:
             if slot.state not in (ALIVE, SPAWNING):
                 return
-            rc = slot.proc.poll() if slot.proc is not None else None
+            proc, channel = slot.proc, slot.channel
+            rc = proc.poll() if proc is not None else None
             slot.lease = None
             retire = self._schedule_backoff_locked(slot)
+            failures = slot.consecutive_failures
         self.counter("workers_lost").inc()
         R.log_event("fleet_worker_lost", worker=slot.index, reason=reason,
-                    rc=rc, consecutive_failures=slot.consecutive_failures,
+                    rc=rc, consecutive_failures=failures,
                     retired=retire)
-        if slot.channel is not None:
-            slot.channel.mark_dead()
-        if slot.proc is not None and slot.proc.poll() is None:
+        if channel is not None:
+            channel.mark_dead()
+        if proc is not None and proc.poll() is None:
             # frozen or wedged, not dead: SIGKILL also breaks the channel's
             # in-flight HTTP call, which is what triggers the requeue
             try:
-                slot.proc.kill()
+                proc.kill()
             except OSError as e:
                 R.log_event("fleet_kill_error", worker=slot.index,
                             error=repr(e))
@@ -471,12 +483,14 @@ class FleetSupervisor:
         clear_lease(self.paths, slot.index)
         if retire:
             R.log_event("fleet_slot_retired", worker=slot.index,
-                        failures=slot.consecutive_failures)
+                        failures=failures)
 
     def _spawn_failed(self, slot: _WorkerSlot, reason: str) -> None:
-        if slot.proc is not None and slot.proc.poll() is None:
+        with self._lock:
+            proc = slot.proc
+        if proc is not None and proc.poll() is None:
             try:
-                slot.proc.kill()
+                proc.kill()
             except OSError as e:
                 R.log_event("fleet_kill_error", worker=slot.index,
                             error=repr(e))
@@ -503,9 +517,19 @@ class FleetSupervisor:
             now = time.time()
             alive = 0
             for slot in self._slots:
-                state = slot.state
+                # snapshot the slot under the lock, act on the copy: the
+                # branch bodies re-check state under the lock before any
+                # dependent write, so a stale snapshot costs one poll tick,
+                # never a lost transition
+                with self._lock:
+                    state = slot.state
+                    proc = slot.proc
+                    spawn_deadline = slot.spawn_deadline
+                    respawn_at = slot.respawn_at
+                    channel = slot.channel
+                    failures = slot.consecutive_failures
                 if state == ALIVE:
-                    rc = slot.proc.poll()
+                    rc = proc.poll()
                     lease = read_lease(self.paths, slot.index)
                     if rc is not None:
                         self._worker_failed(slot, self._rc_reason(rc))
@@ -528,9 +552,9 @@ class FleetSupervisor:
                                         > self._healthy_reset_s):
                                     slot.consecutive_failures = 0
                 elif state == SPAWNING:
-                    rc = slot.proc.poll()
+                    rc = proc.poll()
                     lease = read_lease(self.paths, slot.index)
-                    ours = lease is not None and lease.pid == slot.proc.pid
+                    ours = lease is not None and lease.pid == proc.pid
                     if ours and lease.ready:
                         # dispatch is gated on READINESS, not liveness: a
                         # worker publishes its lease with ready=False while
@@ -543,7 +567,7 @@ class FleetSupervisor:
                         self._spawn_failed(
                             slot, f"{self._rc_reason(rc)} before publishing "
                             "a ready lease")
-                    elif now > slot.spawn_deadline:
+                    elif now > spawn_deadline:
                         self._spawn_failed(slot, "no ready lease within "
                                            f"{self.cfg.fleet.spawn_timeout_s}s"
                                            " (spawn_timeout_s covers load + "
@@ -558,28 +582,28 @@ class FleetSupervisor:
                                 if self._vae_scale is None:
                                     self._vae_scale = lease.vae_scale
                 elif state == BACKOFF:
-                    channel_done = (slot.channel is None
-                                    or slot.channel.finished())
+                    channel_done = (channel is None
+                                    or channel.finished())
                     # a drain suppresses respawns ONLY once the backlog is
                     # gone: if the last worker dies mid-drain with accepted
                     # requests still requeued, refusing to respawn would
                     # strand them until the shutdown timeout 500s them —
                     # breaking "every accepted request receives its response"
-                    if (channel_done and now >= slot.respawn_at
-                            and (not self._draining
+                    if (channel_done and now >= respawn_at
+                            and (not self._draining.is_set()
                                  or self.journal.pending_count() > 0)):
                         # the old incarnation's channel has fully unwound
                         # (its orphan sweep ran), so requeue/dispatch can't
                         # race the fresh incarnation
                         with tracing.span("fleet/respawn", worker=slot.index,
-                                          failures=slot.consecutive_failures):
+                                          failures=failures):
                             self.counter("respawns").inc()
                             self._spawn(slot)
             tracing.registry().gauge("fleet/workers_alive").set(float(alive))
             self._update_slo_gauges(alive)
-            if (alive == 0
-                    and all(s.state == RETIRED for s in self._slots)
-                    and not self._fatal.is_set()):
+            with self._lock:
+                all_retired = all(s.state == RETIRED for s in self._slots)
+            if alive == 0 and all_retired and not self._fatal.is_set():
                 self._fail_fleet()
 
     def _update_slo_gauges(self, alive: int) -> None:
@@ -609,16 +633,22 @@ class FleetSupervisor:
         growing staleness gauge."""
         period = self.cfg.fleet.scrape_period_s
         while not self._shutdown.wait(period):
-            for slot in self._slots:
-                lease = slot.lease
-                if slot.state == ALIVE and lease is not None:
-                    ok = self._scrape.scrape(slot.index, lease.port)
-                    # close the scrape/retire race: a GET in flight when the
-                    # monitor retires the slot (and forgets its section)
-                    # would otherwise re-insert the dead worker's metrics
-                    # with nothing left to ever clear them
-                    if ok and slot.state == RETIRED:
-                        self._scrape.forget(slot.index)
+            # snapshot (slot, lease) pairs under the lock — the monitor
+            # writes slot.lease under it — then scrape outside the lock so
+            # a slow target never stalls state transitions
+            with self._lock:
+                targets = [(slot, slot.lease) for slot in self._slots
+                           if slot.state == ALIVE and slot.lease is not None]
+            for slot, lease in targets:
+                ok = self._scrape.scrape(slot.index, lease.port)
+                # close the scrape/retire race: a GET in flight when the
+                # monitor retires the slot (and forgets its section)
+                # would otherwise re-insert the dead worker's metrics
+                # with nothing left to ever clear them
+                if ok:
+                    with self._lock:
+                        if slot.state == RETIRED:
+                            self._scrape.forget(slot.index)
 
     def prometheus_merged(self) -> str:
         """The fleet-wide ``/metrics?format=prometheus`` document: the
@@ -648,11 +678,13 @@ class FleetSupervisor:
             "last successful registry scrape",
             "# TYPE dcr_fleet_worker_scrape_age_seconds gauge",
         ]
-        for slot in self._slots:
-            label = {"worker": str(slot.index)}
-            text_age = scraped.get(slot.index)
+        with self._lock:
+            slot_states = [(s.index, s.state) for s in self._slots]
+        for index, state in slot_states:
+            label = {"worker": str(index)}
+            text_age = scraped.get(index)
             fresh = text_age is not None and text_age[1] <= stale_after
-            up = 1 if (slot.state == ALIVE and fresh) else 0
+            up = 1 if (state == ALIVE and fresh) else 0
             up_lines.append(inject_labels(
                 f"dcr_fleet_worker_up {up}", label).rstrip("\n"))
             if text_age is not None:
@@ -895,18 +927,20 @@ class FleetSupervisor:
         f = self.cfg.fleet
         bucket = bucket or self.default_bucket()
         try:
-            if self._draining:
+            if self._draining.is_set():
                 raise DrainingError(
                     "service is draining; not accepting requests")
             if self._fatal.is_set():
                 raise NoWorkersError(
                     "fleet failed: every worker slot is retired",
                     retry_after_s=f.shed_retry_after_s)
-            if self._vae_scale is None:
+            with self._lock:   # published by the monitor under the same lock
+                vae_scale = self._vae_scale
+            if vae_scale is None:
                 raise NoWorkersError(
                     "no worker has joined yet (fleet warming up)",
                     retry_after_s=f.shed_retry_after_s)
-            validate_bucket(bucket, vae_scale=self._vae_scale)
+            validate_bucket(bucket, vae_scale=vae_scale)
             self._check_shed()      # before the bucket is registered
             with self._buckets_lock:
                 bucket_added = bucket not in self._admitted_buckets
@@ -986,7 +1020,7 @@ class FleetSupervisor:
 
     @property
     def draining(self) -> bool:
-        return self._draining
+        return self._draining.is_set()
 
     @property
     def fatal(self) -> bool:
@@ -997,9 +1031,11 @@ class FleetSupervisor:
     def health(self) -> str:
         if self._fatal.is_set():
             return "failed"
-        if self._draining:
+        if self._draining.is_set():
             return "draining"
-        if self._vae_scale is None or not self._ever_ready:
+        with self._lock:   # written by the monitor thread under the same lock
+            vae_scale, ever_ready = self._vae_scale, self._ever_ready
+        if vae_scale is None or not ever_ready:
             # cold boot: no worker has EVER reached ready — "warming" even
             # though admission may already be queueing. (After first ready,
             # transient all-workers-down churn keeps reporting "ok" exactly
@@ -1026,7 +1062,7 @@ class FleetSupervisor:
         """Stop admission. The shared queue is NOT closed: requeues of
         already-accepted work must keep landing while channels drain the
         backlog."""
-        self._draining = True
+        self._draining.set()
         R.log_trace("fleet_drain_begin", pending=self.journal.pending_count())
 
     def join_drained(self, timeout_s: float) -> bool:
@@ -1045,16 +1081,21 @@ class FleetSupervisor:
         reap. Call after :meth:`join_drained`; anything still pending at
         this point gets a typed failure, not silence."""
         self._shutdown.set()
-        for slot in self._slots:
-            if slot.channel is not None:
-                slot.channel.stop()
+        # snapshot channels/procs under the lock once: the monitor thread
+        # may still be mid-tick attaching a channel when shutdown starts
+        with self._lock:
+            channels = [s.channel for s in self._slots]
+            procs = [(s.index, s.proc) for s in self._slots]
+        for channel in channels:
+            if channel is not None:
+                channel.stop()
         # one shared deadline across all channel joins (same pattern as the
         # proc reap below): N wedged channels must not serialize into
         # N x timeout_s before workers even see SIGTERM
         join_deadline = time.monotonic() + timeout_s
-        for slot in self._slots:
-            if slot.channel is not None:
-                slot.channel.join(
+        for channel in channels:
+            if channel is not None:
+                channel.join(
                     max(0.1, join_deadline - time.monotonic()))
         with self._requests_lock:
             leftovers = list(self._requests.values())
@@ -1065,27 +1106,27 @@ class FleetSupervisor:
                     req.future.set_exception(RequestFailedError(
                         "supervisor shut down before the request completed"))
             self._finish(req.id)
-        for slot in self._slots:
-            if slot.proc is not None and slot.proc.poll() is None:
+        for index, proc in procs:
+            if proc is not None and proc.poll() is None:
                 try:
-                    slot.proc.send_signal(signal.SIGTERM)
+                    proc.send_signal(signal.SIGTERM)
                 except OSError as e:
-                    R.log_event("fleet_term_error", worker=slot.index,
+                    R.log_event("fleet_term_error", worker=index,
                                 error=repr(e))
                     R.bump_counter("fleet_term_errors")
         deadline = time.monotonic() + timeout_s
-        for slot in self._slots:
-            if slot.proc is None:
+        for index, proc in procs:
+            if proc is None:
                 continue
             try:
-                slot.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+                proc.wait(timeout=max(0.1, deadline - time.monotonic()))
             except subprocess.TimeoutExpired:
-                R.log_event("fleet_worker_drain_timeout", worker=slot.index)
+                R.log_event("fleet_worker_drain_timeout", worker=index)
                 try:
-                    slot.proc.kill()
-                    slot.proc.wait(timeout=10)
+                    proc.kill()
+                    proc.wait(timeout=10)
                 except (OSError, subprocess.TimeoutExpired) as e:
-                    R.log_event("fleet_kill_error", worker=slot.index,
+                    R.log_event("fleet_kill_error", worker=index,
                                 error=repr(e))
                     R.bump_counter("fleet_kill_errors")
         if self._monitor is not None:
@@ -1103,7 +1144,7 @@ class FleetSupervisor:
         d = {
             "role": "supervisor",
             "health": self.health(),
-            "draining": self._draining,
+            "draining": self._draining.is_set(),
             "queue_depth": self.queue.depth(),
             "workers": [s.snapshot() for s in self._slots],
             "workers_alive": sum(1 for s in self._slots if s.state == ALIVE),

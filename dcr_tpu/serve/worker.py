@@ -343,10 +343,13 @@ class GenerationService:
         self._risk_done = threading.Event()
         self._pump = None             # IngestPump (dcr-live), risk+ingest on
         self._evidence = None
+        self._risk_thread: Optional[threading.Thread] = None
         if cfg.risk.index_path or cfg.risk.store_dir:
             self._risk_status = "loading"
-            threading.Thread(target=self._load_risk_index, daemon=True,
-                             name="risk-index-load").start()
+            self._risk_thread = threading.Thread(
+                target=self._load_risk_index, daemon=True,
+                name="risk-index-load")
+            self._risk_thread.start()
         else:
             self._risk_done.set()
         self._uncond: Optional[np.ndarray] = None

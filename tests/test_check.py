@@ -661,14 +661,17 @@ def test_manifest_jax_version_mismatch_skips_hlo_digest():
 
 def test_repo_program_scan_is_clean():
     from tools.check.config import load_check_config
-    from tools.check.engine import scan_program
+    from tools.check.engine import run_layer1
 
+    # run_layer1 (not raw scan_program): the concurrency/durability rules
+    # carry justified baseline entries, applied at this layer
     cfg = load_check_config(pyproject=REPO / "pyproject.toml")
-    findings, _, n_modules = scan_program(cfg)
+    report = run_layer1(cfg, pyproject=REPO / "pyproject.toml",
+                        include_local=False)
     pretty = "\n".join(f"{f.path}:{f.line}: {f.rule} {f.message}"
-                       for f in findings)
-    assert findings == [], f"whole-program findings:\n{pretty}"
-    assert n_modules > 50
+                       for f in report.program)
+    assert report.program == [], f"whole-program findings:\n{pretty}"
+    assert report.modules_analyzed > 50
 
 
 def test_repo_registered_surfaces_match_expectations():

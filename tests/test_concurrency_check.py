@@ -1,0 +1,722 @@
+"""dcr-race self-tests: thread-safety + durability static analysis.
+
+Mirrors tests/test_check.py's fixture style: every fixture is a small
+multi-module tmp package, because the point of DCR011–DCR015 is exactly
+the facts that cross a method/module boundary (thread roots, locksets
+through helpers, lock-order graphs, fsync closures). Three layers:
+
+1. per-rule positive/negative fixtures — each rule has at least one
+   firing case and one structurally-similar clean case (lock through a
+   helper method, exempted Queue-typed attribute, consistent lock order,
+   fsync-through-helper, stored thread handle);
+2. suppression round-trips — the shared ``# dcr-lint: disable=`` pragma
+   and the justified-baseline file both silence a program-layer finding;
+3. the repo self-scan — the full tree is clean under DCR011–DCR015 with
+   every baseline entry consumed (none stale).
+
+Pure-AST fixtures (nothing is imported at check time); fast tier.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import pytest
+
+from tools.check.config import CheckConfig
+from tools.check.engine import run_layer1, scan_program
+
+pytestmark = pytest.mark.fast
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def write_pkg(root: Path, files: dict[str, str]) -> None:
+    for rel, src in files.items():
+        p = root / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src, encoding="utf-8")
+
+
+def race_rules(tmp_path: Path, files: dict[str, str], *,
+               hot_paths=(), wal_modules=()) -> list:
+    write_pkg(tmp_path, files)
+    cfg = CheckConfig(roots=("pkg",), hot_paths=tuple(hot_paths),
+                      entry_modules=(), wal_modules=tuple(wal_modules),
+                      best_effort_writers=(), root=tmp_path,
+                      manifest="compile_manifest.json")
+    findings, _, _ = scan_program(cfg)
+    return findings
+
+
+def rule_set(findings) -> set[str]:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# DCR011 — unguarded shared state across thread roots
+# ---------------------------------------------------------------------------
+
+def test_dcr011_unguarded_counter_fires(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/pump.py": """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.count += 1
+
+    def stats(self):
+        return {"count": self.count}
+""",
+    })
+    assert rule_set(findings) == {"DCR011"}
+    (f,) = findings
+    assert "Pump.count" in f.message and "_run" in f.message
+
+
+def test_dcr011_annotated_param_helper_fires(tmp_path):
+    # the racy write goes through a helper that receives the shared object
+    # as an ANNOTATED parameter (`slot: Slot`) rather than iterating the
+    # container — parameter annotations must type the access too
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/fleet.py": """
+import threading
+
+class Slot:
+    def __init__(self):
+        self.state = 0
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = [Slot() for _ in range(2)]
+        self._thread = threading.Thread(target=self._monitor, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _monitor(self):
+        for slot in self._slots:
+            self._bump(slot)
+
+    def _bump(self, slot: Slot):
+        slot.state += 1
+
+    def status(self):
+        out = []
+        with self._lock:
+            for s in self._slots:
+                out.append(s.state)
+        return out
+""",
+    })
+    assert rule_set(findings) == {"DCR011"}
+    assert any("Slot.state" in f.message for f in findings)
+
+
+def test_dcr011_annotated_param_guarded_is_clean(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/fleet.py": """
+import threading
+
+class Slot:
+    def __init__(self):
+        self.state = 0
+
+class Fleet:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._slots = [Slot() for _ in range(2)]
+        self._thread = threading.Thread(target=self._monitor, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _monitor(self):
+        for slot in self._slots:
+            with self._lock:
+                self._bump(slot)
+
+    def _bump(self, slot: Slot):
+        slot.state += 1
+
+    def status(self):
+        out = []
+        with self._lock:
+            for s in self._slots:
+                out.append(s.state)
+        return out
+""",
+    })
+    assert findings == []
+
+
+def test_dcr011_lock_through_helper_is_clean(tmp_path):
+    # the write happens inside a private helper whose EVERY call site holds
+    # the lock — the guaranteed-lockset fixpoint must resolve it
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/pump.py": """
+import threading
+
+class Pump:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _bump(self):
+        self.count += 1
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self._bump()
+
+    def stats(self):
+        with self._lock:
+            return {"count": self.count}
+""",
+    })
+    assert findings == []
+
+
+def test_dcr011_queue_typed_attr_is_exempt(tmp_path):
+    # queue.Queue is internally synchronized: cross-thread use is its job
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/pump.py": """
+import queue
+import threading
+
+class Pump:
+    def __init__(self):
+        self.q = queue.Queue(maxsize=8)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while True:
+            self.q.put_nowait(1)
+
+    def take(self):
+        return self.q.get(timeout=1.0)
+""",
+    })
+    assert findings == []
+
+
+def test_dcr011_no_thread_entry_is_clean(tmp_path):
+    # a class that never starts a thread has a single root: no pair exists
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/plain.py": """
+class Plain:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+    def stats(self):
+        return self.count
+""",
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# DCR012 — lock-order inversion / deadlock cycles
+# ---------------------------------------------------------------------------
+
+THREE_LOCK_CYCLE = """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def m2(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def m3(self):
+        with self._c:
+            with self._a:
+                pass
+"""
+
+
+def test_dcr012_three_lock_cycle_fires(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": THREE_LOCK_CYCLE,
+    })
+    assert rule_set(findings) == {"DCR012"}
+    msg = findings[0].message
+    # the witness path names all three locks
+    for attr in ("_a", "_b", "_c"):
+        assert attr in msg
+
+
+def test_dcr012_consistent_order_is_clean(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def m2(self):
+        with self._a:
+            with self._b:
+                pass
+""",
+    })
+    assert findings == []
+
+
+def test_dcr012_interprocedural_cycle_through_call(tmp_path):
+    # m3 holds _c and CALLS m1, which acquires _a: the c->a edge exists
+    # only through the call graph
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": """
+import threading
+
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def m1(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def m2(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def m3(self):
+        with self._c:
+            self.m1()
+""",
+    })
+    assert "DCR012" in rule_set(findings)
+
+
+def test_dcr012_nonreentrant_self_deadlock(tmp_path):
+    # plain Lock re-acquired under itself deadlocks; RLock is fine
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/locks.py": """
+import threading
+
+class C:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._re = threading.RLock()
+
+    def bad(self):
+        with self._mu:
+            with self._mu:
+                pass
+
+    def fine(self):
+        with self._re:
+            with self._re:
+                pass
+""",
+    })
+    assert rule_set(findings) == {"DCR012"}
+    assert all("_mu" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# DCR013 — blocking call under a held lock (hot paths)
+# ---------------------------------------------------------------------------
+
+SLEEPER = """
+import threading
+import time
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+
+    def bad(self):
+        with self._mu:
+            time.sleep(1.0)
+
+    def fine(self):
+        time.sleep(1.0)
+        with self._mu:
+            pass
+"""
+
+
+def test_dcr013_sleep_under_lock_on_hot_path(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/hot.py": SLEEPER,
+    }, hot_paths=("pkg/",))
+    assert rule_set(findings) == {"DCR013"}
+    (f,) = findings
+    assert "time.sleep" in f.message and "_mu" in f.message
+
+
+def test_dcr013_silent_off_hot_path(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/cold.py": SLEEPER,
+    }, hot_paths=())
+    assert findings == []
+
+
+def test_dcr013_untimed_queue_get_under_lock(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/hot.py": """
+import queue
+import threading
+
+class S:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.q = queue.Queue()
+
+    def bad(self):
+        with self._mu:
+            return self.q.get()
+
+    def fine(self):
+        with self._mu:
+            return self.q.get(timeout=0.5)
+""",
+    }, hot_paths=("pkg/",))
+    assert rule_set(findings) == {"DCR013"}
+
+
+# ---------------------------------------------------------------------------
+# DCR014 — torn publish / ack-before-fsync
+# ---------------------------------------------------------------------------
+
+def test_dcr014_rename_without_fsync_fires(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/save.py": """
+import json
+import os
+
+def publish(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(doc))
+    os.replace(tmp, path)
+""",
+    })
+    assert rule_set(findings) == {"DCR014"}
+
+
+def test_dcr014_fsync_before_rename_is_clean(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/save.py": """
+import json
+import os
+
+def publish(path, doc):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(doc))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+""",
+    })
+    assert findings == []
+
+
+def test_dcr014_fsync_through_helper_is_resolved(tmp_path):
+    # the fsync lives in another module's helper; the call-graph closure
+    # must credit it to the publishing scope
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/fsio.py": """
+import os
+
+def flush_hard(f):
+    f.flush()
+    os.fsync(f.fileno())
+""",
+        "pkg/save.py": """
+import os
+from pkg.fsio import flush_hard
+
+def publish(path, blob):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        flush_hard(f)
+    os.replace(tmp, path)
+""",
+    })
+    assert findings == []
+
+
+def test_dcr014_pure_rename_is_exempt(tmp_path):
+    # rotation/quarantine: nothing was written, nothing can be torn
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/rotate.py": """
+import os
+
+def quarantine(path):
+    os.replace(path, path + ".quarantined")
+""",
+    })
+    assert findings == []
+
+
+def test_dcr014_wal_ack_without_fsync_fires(tmp_path):
+    files = {
+        "pkg/__init__.py": "",
+        "pkg/wal.py": """
+def append(f, record):
+    f.write(record)
+    f.flush()
+    return True
+""",
+    }
+    findings = race_rules(tmp_path, dict(files), wal_modules=("pkg/wal.py",))
+    assert rule_set(findings) == {"DCR014"}
+    # the same module NOT marked as WAL is clean: leg 2 is contract-scoped
+    assert race_rules(tmp_path, files, wal_modules=()) == []
+
+
+def test_dcr014_wal_fsync_after_last_write_is_clean(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/wal.py": """
+import os
+
+def append(f, record):
+    f.write(record)
+    f.flush()
+    os.fsync(f.fileno())
+    return True
+""",
+    }, wal_modules=("pkg/wal.py",))
+    assert findings == []
+
+
+def test_dcr014_wal_staging_buffer_is_exempt(tmp_path):
+    # serializing into BytesIO is not a file write — both as a .write()
+    # receiver and as a serializer argument
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/wal.py": """
+import io
+import json
+
+def encode(doc):
+    buf = io.BytesIO()
+    buf.write(b"MAGIC")
+    json.dump(doc, buf)
+    return buf.getvalue()
+""",
+    }, wal_modules=("pkg/wal.py",))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# DCR015 — leaked thread handle
+# ---------------------------------------------------------------------------
+
+def test_dcr015_discarded_thread_fires(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": """
+import threading
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn, daemon=True).start()
+""",
+    })
+    assert rule_set(findings) == {"DCR015"}
+
+
+def test_dcr015_local_started_never_joined_fires(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": """
+import threading
+
+def run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    return None
+""",
+    })
+    assert rule_set(findings) == {"DCR015"}
+
+
+def test_dcr015_stored_or_joined_is_clean(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": """
+import threading
+
+class Owner:
+    def __init__(self, fn):
+        self._t = threading.Thread(target=fn, daemon=True)
+        self._t.start()
+
+def run_sync(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+""",
+    })
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# suppression round-trips: pragma + justified baseline
+# ---------------------------------------------------------------------------
+
+LEAKY = """
+import threading
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn, daemon=True).start()
+"""
+
+
+def test_pragma_suppresses_program_finding(tmp_path):
+    findings = race_rules(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": LEAKY.replace(
+            ".start()", ".start()  # dcr-lint: disable=DCR015"),
+    })
+    assert findings == []
+
+
+def test_baseline_suppresses_program_finding(tmp_path):
+    write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": LEAKY,
+        "pyproject.toml": """
+[tool.dcr-lint]
+baseline = "baseline.json"
+
+[tool.dcr-check]
+roots = ["pkg"]
+entry-modules = []
+hot-paths = []
+wal-modules = []
+""",
+    })
+    snippet = "threading.Thread(target=fn, daemon=True).start()"
+    (tmp_path / "baseline.json").write_text(json.dumps({"entries": [{
+        "rule": "DCR015", "path": "pkg/spawn.py", "snippet": snippet,
+        "justification": "daemon helper outlives no resource; test fixture",
+    }]}))
+    report = run_layer1(pyproject=tmp_path / "pyproject.toml",
+                        include_local=False, manifest_path=tmp_path / "m.json")
+    assert report.program == []
+    assert report.local.baseline_suppressed == 1
+    assert report.local.stale_baseline == []
+    # without the entry the same tree fails: the suppression is doing work
+    (tmp_path / "baseline.json").write_text(json.dumps({"entries": []}))
+    report = run_layer1(pyproject=tmp_path / "pyproject.toml",
+                        include_local=False, manifest_path=tmp_path / "m.json")
+    assert [f.rule for f in report.program] == ["DCR015"]
+
+
+def test_stale_program_rule_entry_is_reported(tmp_path):
+    # the file-local lint layer never runs DCR011–015, so it refuses to
+    # call their entries stale; run_layer1 must report an entry the
+    # program scan didn't consume, or fixed hazards rot in the baseline
+    write_pkg(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": "def quiet():\n    return 1\n",
+        "pyproject.toml": """
+[tool.dcr-lint]
+baseline = "baseline.json"
+
+[tool.dcr-check]
+roots = ["pkg"]
+entry-modules = []
+hot-paths = []
+wal-modules = []
+""",
+    })
+    (tmp_path / "baseline.json").write_text(json.dumps({"entries": [{
+        "rule": "DCR015", "path": "pkg/spawn.py",
+        "snippet": "threading.Thread(target=fn, daemon=True).start()",
+        "justification": "long gone",
+    }]}))
+    report = run_layer1(pyproject=tmp_path / "pyproject.toml",
+                        include_local=False, manifest_path=tmp_path / "m.json")
+    assert [e["rule"] for e in report.local.stale_baseline] == ["DCR015"]
+
+
+# ---------------------------------------------------------------------------
+# repo self-scan: the tree is race/durability-clean, baseline fully consumed
+# ---------------------------------------------------------------------------
+
+def test_repo_clean_under_concurrency_rules():
+    from tools.check.config import load_check_config
+
+    cfg = load_check_config(pyproject=REPO / "pyproject.toml")
+    report = run_layer1(cfg, pyproject=REPO / "pyproject.toml",
+                        include_local=False)
+    mine = [f for f in report.program
+            if f.rule in ("DCR011", "DCR012", "DCR013", "DCR014", "DCR015")]
+    pretty = "\n".join(f"{f.path}:{f.line}: {f.rule} {f.message}"
+                       for f in mine)
+    assert mine == [], f"race/durability findings:\n{pretty}"
+    # every DCR011–015 baseline entry still matches a real site: a fixed
+    # hazard must drop its entry, not rot in the file
+    stale = [e for e in report.local.stale_baseline
+             if e["rule"].startswith("DCR01")]
+    assert stale == [], f"stale baseline entries: {stale}"

@@ -60,6 +60,13 @@ def _build_parser() -> argparse.ArgumentParser:
 
 
 def _print_layer1(report: CheckReport, fmt: str) -> None:
+    # stale entries go to stderr in every format (json stdout stays pure,
+    # github annotations stay per-finding) — they fail the run, so they
+    # must never fail it silently
+    for entry in report.local.stale_baseline:
+        print(f"dcr-check: stale baseline entry (no longer matches): "
+              f"{entry['rule']} {entry['path']} — remove it",
+              file=sys.stderr)
     if fmt == "json":
         print(json.dumps(report.to_json(), indent=2))
         return
@@ -69,10 +76,6 @@ def _print_layer1(report: CheckReport, fmt: str) -> None:
         return
     for f in report.findings:
         print(f"{f.path}:{f.line}:{f.col + 1}: {f.rule} {f.message}")
-    for entry in report.local.stale_baseline:
-        print(f"dcr-check: stale baseline entry (no longer matches): "
-              f"{entry['rule']} {entry['path']} — remove it",
-              file=sys.stderr)
     counts = report.counts()
     summary = ", ".join(f"{k}×{v}" for k, v in counts.items()) or "clean"
     print(f"dcr-check: {len(report.findings)} finding"
@@ -149,7 +152,10 @@ def main(argv: Optional[list[str]] = None) -> int:
                           f"{f.message} — file could not be parsed; the "
                           "scan is incomplete", file=sys.stderr)
                 return 2
-            rc = 1 if report.findings else 0
+            # a stale entry is a failure like a finding: the baseline must
+            # only ever shrink, and a dead entry would silently grandfather
+            # the next regression matching its snippet
+            rc = 1 if (report.findings or report.local.stale_baseline) else 0
         if not args.no_manifest:
             mrc = _run_manifest(cfg, manifest_path, args.update_manifest,
                                 args.format,

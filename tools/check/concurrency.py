@@ -1,0 +1,972 @@
+"""dcr-check concurrency rules: lock discipline over the whole program.
+
+The repo is a genuinely threaded system (encode producer, ingest pump,
+heartbeat leases, scrape loop, memory sampler, watchdogs, supervisor
+monitor).  This module builds a per-class concurrency model on top of the
+:class:`tools.check.graph.ProgramIndex` and checks four hazard classes:
+
+- **DCR011 unguarded-shared-state** — infer thread entry points per class
+  (``Thread(target=self.m)`` / ``Timer``, ``signal.signal`` handlers,
+  ``do_*`` HTTP handler methods), compute the lock set held at every
+  ``self.<attr>`` read/write (lexical ``with self._lock:`` tracking plus a
+  guaranteed-lockset fixpoint through helper methods), and flag attributes
+  mutated under one thread root and accessed under another with no common
+  lock.  Event/Queue/deque-typed attributes are exempt (internally
+  synchronized), and so are append-only attributes (method calls like
+  ``.append()`` are not writes — only assignment/augassign/subscript-store
+  count).
+- **DCR012 lock-order-inversion** — a global lock-acquisition graph whose
+  nodes are ``(class, attr)`` lock identities and whose edges are nested
+  acquisitions (lexical nesting, plus interprocedural nesting through the
+  call graph); cycles are reported with a witness site per edge.  A direct
+  self-cycle on a non-reentrant ``threading.Lock`` is reported too — that
+  is not an ordering hazard but an instant single-thread deadlock.
+- **DCR013 blocking-call-under-lock** — untimed ``Queue.get`` / ``join`` /
+  ``wait`` / ``Future.result``, socket/HTTP calls, ``os.fsync``,
+  ``time.sleep`` and device ``block_until_ready`` inside a held ``with
+  lock:`` region, on the configured hot-path modules.
+- **DCR015 leaked-thread** — a started ``Thread``/``Timer`` whose handle is
+  neither stored on ``self`` nor joined (nor escapes to a container or a
+  callee that could join it): nothing can ever observe its death.
+
+Everything here is precision-biased the same way the rest of dcr-check is:
+name-based, no type inference beyond constructor/annotation tracking, a
+miss is possible but a hit is near-certainly real.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Optional
+
+from tools.lint.analysis import FuncNode, ModuleAnalysis, _walk_shallow
+from tools.lint.rules import Finding
+from tools.check.config import CheckConfig
+from tools.check.graph import ModuleInfo, ProgramIndex, dotted_chain
+from tools.check.rules import (_FUTURE_RECEIVERS, _bounded_wait, _finding,
+                               _scope_walk, tracked_sync_chains)
+
+# lock identity: (owner, attr) — owner is a class key ``module.Class`` for
+# instance locks, a module name for module-level locks, or a scope label for
+# function-local locks
+LockId = tuple[str, str]
+
+MAIN_ROOT = "<external callers>"
+
+_LOCK_CONSTRUCTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "multiprocessing.Lock": "lock",
+    "multiprocessing.RLock": "rlock",
+}
+# internally-synchronized types: attributes bound to these never need an
+# external lock, whatever threads touch them
+_SAFE_CONSTRUCTORS = {
+    "threading.Event", "threading.Semaphore", "threading.BoundedSemaphore",
+    "threading.Barrier", "queue.Queue", "queue.LifoQueue",
+    "queue.PriorityQueue", "queue.SimpleQueue", "multiprocessing.Queue",
+    "collections.deque",
+}
+_SAFE_ANNOTATIONS = {"Event", "Queue", "LifoQueue", "PriorityQueue",
+                     "SimpleQueue", "deque", "Semaphore", "Barrier"}
+_THREAD_CONSTRUCTORS = {"threading.Thread", "threading.Timer"}
+_HANDLER_BASES = {"BaseHTTPRequestHandler", "SimpleHTTPRequestHandler",
+                  "StreamRequestHandler", "DatagramRequestHandler",
+                  "BaseRequestHandler"}
+_CONTAINER_GENERICS = {"list", "List", "Sequence", "MutableSequence", "set",
+                       "Set", "frozenset", "tuple", "Tuple", "deque", "Deque"}
+_MAPPING_GENERICS = {"dict", "Dict", "Mapping", "MutableMapping",
+                     "OrderedDict", "defaultdict"}
+
+
+def _is_public(method: str) -> bool:
+    return (not method.startswith("_")
+            or (method.startswith("__") and method.endswith("__")))
+
+
+@dataclass
+class Access:
+    owner: str          # state-owner class key ("module.Class")
+    attr: str
+    write: bool
+    cls: str            # class key of the method performing the access
+    method: str         # base method name in that class
+    label: str          # full scope label for messages
+    path: str
+    line: int
+    locks: frozenset    # LockIds lexically held at the access
+
+
+@dataclass
+class Acquire:
+    lock: LockId
+    held: frozenset
+    scope_key: tuple    # (owner key, base method/function name)
+    label: str
+    path: str
+    line: int
+
+
+@dataclass
+class CallSite:
+    node: ast.Call
+    held: frozenset
+    target: Optional[tuple]   # (class key, method) | (module, fn) | None
+    scope_key: tuple
+    cls: Optional[str]
+    label: str
+    path: str
+    line: int
+
+
+@dataclass
+class ClassModel:
+    key: str
+    name: str
+    info: ModuleInfo
+    node: ast.ClassDef
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    lock_attrs: dict[str, str] = field(default_factory=dict)  # attr -> kind
+    safe_attrs: set[str] = field(default_factory=set)
+    attr_types: dict[str, str] = field(default_factory=dict)  # attr -> class
+    elem_types: dict[str, str] = field(default_factory=dict)  # container elem
+    entries: set[str] = field(default_factory=set)
+    roots: dict[str, frozenset] = field(default_factory=dict)
+    guaranteed: dict[str, frozenset] = field(default_factory=dict)
+
+
+class ConcurrencyIndex:
+    """Whole-program lock/thread model; built once, consumed by DCR011-013."""
+
+    def __init__(self, index: ProgramIndex):
+        self.index = index
+        self.classes: dict[str, ClassModel] = {}
+        self.accesses: list[Access] = []
+        self.acquires: list[Acquire] = []
+        self.calls: dict[str, list[CallSite]] = {}
+        self.lock_kinds: dict[LockId, str] = {}
+        self.module_locks: dict[str, dict[str, LockId]] = {}
+        for info in index.modules.values():
+            self._collect_classes(info)
+        for cm in self.classes.values():
+            self._collect_attrs(cm)
+        for info in index.modules.values():
+            self._walk_module(info)
+        for cm in self.classes.values():
+            self._compute_roots(cm)
+            self._compute_guaranteed(cm)
+        self.tacq = self._transitive_acquires()
+
+    # -- model construction ---------------------------------------------------
+
+    def _collect_classes(self, info: ModuleInfo) -> None:
+        for node in info.analysis.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cm = ClassModel(key=f"{info.name}.{node.name}", name=node.name,
+                            info=info, node=node)
+            for stmt in node.body:
+                if isinstance(stmt, FuncNode):
+                    cm.methods[stmt.name] = stmt
+            for base in node.bases:
+                if ModuleAnalysis.last_segment(base) in _HANDLER_BASES:
+                    cm.entries |= {m for m in cm.methods
+                                   if m.startswith("do_")}
+            self.classes[cm.key] = cm
+
+    def _resolve_ctor(self, info: ModuleInfo, call: ast.Call) -> Optional[str]:
+        d = info.analysis.dotted(call.func)
+        return info.resolve(d) if d else None
+
+    def _class_key_of(self, info: ModuleInfo, name: str) -> Optional[str]:
+        """Resolve a (possibly dotted, possibly aliased) class reference to a
+        key in ``self.classes``."""
+        resolved = info.resolve(name)
+        if resolved in self.classes:
+            return resolved
+        local = f"{info.name}.{resolved}"
+        return local if local in self.classes else None
+
+    def _annotation_types(self, info: ModuleInfo, ann: ast.AST
+                          ) -> tuple[Optional[str], Optional[str]]:
+        """(direct class key, container-element class key) from a type
+        annotation: ``RequestJournal`` / ``Optional[RequestJournal]`` give a
+        direct type, ``list[_WorkerSlot]`` / ``dict[int, _WorkerSlot]`` give
+        an element type."""
+        d = info.analysis.dotted(ann)
+        if d is not None:
+            return self._class_key_of(info, d), None
+        if isinstance(ann, ast.Subscript):
+            base = ModuleAnalysis.last_segment(ann.value)
+            sl = ann.slice
+            if base == "Optional":
+                inner = info.analysis.dotted(sl)
+                return (self._class_key_of(info, inner) if inner else None,
+                        None)
+            if base in _CONTAINER_GENERICS:
+                inner = info.analysis.dotted(sl)
+                return None, (self._class_key_of(info, inner)
+                              if inner else None)
+            if base in _MAPPING_GENERICS and isinstance(sl, ast.Tuple) \
+                    and len(sl.elts) == 2:
+                inner = info.analysis.dotted(sl.elts[1])
+                return None, (self._class_key_of(info, inner)
+                              if inner else None)
+        return None, None
+
+    def _value_elem_type(self, info: ModuleInfo,
+                         value: ast.AST) -> Optional[str]:
+        """Element class key of a literal container of constructor calls."""
+        elts: list[ast.AST] = []
+        if isinstance(value, (ast.List, ast.Set, ast.Tuple)):
+            elts = value.elts
+        elif isinstance(value, ast.ListComp):
+            elts = [value.elt]
+        elif isinstance(value, ast.DictComp):
+            elts = [value.value]
+        for e in elts:
+            if isinstance(e, ast.Call):
+                r = self._resolve_ctor(info, e)
+                if r is not None:
+                    key = self._class_key_of(info, r)
+                    if key is not None:
+                        return key
+        return None
+
+    def _collect_attrs(self, cm: ClassModel) -> None:
+        info = cm.info
+        for method in cm.methods.values():
+            for node in _scope_walk(method.body):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    self._attr_from_assign(cm, node)
+                elif isinstance(node, ast.Call):
+                    self._entry_from_call(cm, node)
+
+    def _attr_from_assign(self, cm: ClassModel, node: ast.AST) -> None:
+        info = cm.info
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        attrs = []
+        for t in targets:
+            c = dotted_chain(t)
+            if c is not None and c.startswith("self.") and c.count(".") == 1:
+                attrs.append(c.split(".", 1)[1])
+        if not attrs:
+            return
+        if isinstance(node, ast.AnnAssign) and node.annotation is not None:
+            direct, elem = self._annotation_types(info, node.annotation)
+            base = ModuleAnalysis.last_segment(node.annotation)
+            for a in attrs:
+                if direct is not None:
+                    cm.attr_types.setdefault(a, direct)
+                if elem is not None:
+                    cm.elem_types.setdefault(a, elem)
+                if base in _SAFE_ANNOTATIONS:
+                    cm.safe_attrs.add(a)
+        value = getattr(node, "value", None)
+        if isinstance(value, ast.Call):
+            r = self._resolve_ctor(info, value)
+            if r in _LOCK_CONSTRUCTORS:
+                for a in attrs:
+                    cm.lock_attrs[a] = _LOCK_CONSTRUCTORS[r]
+                    self.lock_kinds[(cm.key, a)] = _LOCK_CONSTRUCTORS[r]
+            elif r in _SAFE_CONSTRUCTORS:
+                cm.safe_attrs.update(attrs)
+            elif r is not None:
+                key = self._class_key_of(info, r)
+                if key is not None:
+                    for a in attrs:
+                        cm.attr_types.setdefault(a, key)
+        elif value is not None:
+            elem = self._value_elem_type(info, value)
+            if elem is not None:
+                for a in attrs:
+                    cm.elem_types.setdefault(a, elem)
+
+    def _entry_from_call(self, cm: ClassModel, call: ast.Call) -> None:
+        info = cm.info
+        r = self._resolve_ctor(info, call)
+
+        def own_method(expr: ast.AST) -> Optional[str]:
+            c = dotted_chain(expr)
+            if c and c.startswith("self.") and c.count(".") == 1:
+                m = c.split(".", 1)[1]
+                if m in cm.methods:
+                    return m
+            return None
+
+        if r in _THREAD_CONSTRUCTORS:
+            for kw in call.keywords:
+                if kw.arg in ("target", "function"):
+                    m = own_method(kw.value)
+                    if m:
+                        cm.entries.add(m)
+            if r == "threading.Timer" and len(call.args) >= 2:
+                m = own_method(call.args[1])
+                if m:
+                    cm.entries.add(m)
+        elif r == "signal.signal" and len(call.args) >= 2:
+            m = own_method(call.args[1])
+            if m:
+                cm.entries.add(m)
+
+    # -- scope walking --------------------------------------------------------
+
+    def _walk_module(self, info: ModuleInfo) -> None:
+        mlocks: dict[str, LockId] = {}
+        for stmt in info.analysis.tree.body:
+            if isinstance(stmt, (ast.Assign, ast.AnnAssign)) and \
+                    isinstance(getattr(stmt, "value", None), ast.Call):
+                r = self._resolve_ctor(info, stmt.value)
+                if r in _LOCK_CONSTRUCTORS:
+                    targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                        else [stmt.target]
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            lid = (info.name, t.id)
+                            mlocks[t.id] = lid
+                            self.lock_kinds[lid] = _LOCK_CONSTRUCTORS[r]
+        self.module_locks[info.name] = mlocks
+        self.calls.setdefault(info.name, [])
+        for stmt in info.analysis.tree.body:
+            if isinstance(stmt, FuncNode):
+                self._walk_scope(info, None, stmt.name, stmt, mlocks)
+            elif isinstance(stmt, ast.ClassDef):
+                cm = self.classes.get(f"{info.name}.{stmt.name}")
+                if cm is None:
+                    continue
+                for sub in stmt.body:
+                    if isinstance(sub, FuncNode):
+                        self._walk_scope(info, cm, sub.name, sub, mlocks)
+
+    def _local_model(self, info: ModuleInfo, cm: Optional[ClassModel],
+                     label: str, fn: ast.AST
+                     ) -> tuple[dict[str, LockId], dict[str, str]]:
+        """(function-local locks, local var -> class key) for one scope."""
+        local_locks: dict[str, LockId] = {}
+        local_types: dict[str, str] = {}
+
+        # annotated parameters type their accesses too: a helper taking
+        # ``slot: _WorkerSlot`` touches the same shared state as the loop
+        # that iterates ``self._slots``
+        if isinstance(fn, FuncNode):
+            for arg in (list(fn.args.posonlyargs) + list(fn.args.args)
+                        + list(fn.args.kwonlyargs)):
+                if arg.annotation is None:
+                    continue
+                direct, _ = self._annotation_types(info, arg.annotation)
+                if direct is not None:
+                    local_types.setdefault(arg.arg, direct)
+
+        def elem_of_self_attr(expr: ast.AST) -> Optional[str]:
+            if cm is None:
+                return None
+            # self.A (container read), self.A[i], self.A.values(), and
+            # enumerate(self.A) all surface the container's element type
+            if isinstance(expr, ast.Subscript):
+                expr = expr.value
+            if isinstance(expr, ast.Call):
+                if isinstance(expr.func, ast.Attribute) and \
+                        expr.func.attr == "values":
+                    expr = expr.func.value
+                elif isinstance(expr.func, ast.Name) and \
+                        expr.func.id == "enumerate" and expr.args:
+                    expr = expr.args[0]
+            c = dotted_chain(expr)
+            if c and c.startswith("self.") and c.count(".") == 1:
+                return cm.elem_types.get(c.split(".", 1)[1])
+            return None
+
+        for node in _scope_walk(fn.body):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                value = getattr(node, "value", None)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                name = targets[0].id if len(targets) == 1 and \
+                    isinstance(targets[0], ast.Name) else None
+                if name is None or value is None:
+                    continue
+                if isinstance(value, ast.Call):
+                    r = self._resolve_ctor(info, value)
+                    if r in _LOCK_CONSTRUCTORS:
+                        lid = (f"{info.name}.{label}", name)
+                        local_locks[name] = lid
+                        self.lock_kinds[lid] = _LOCK_CONSTRUCTORS[r]
+                        continue
+                elem = elem_of_self_attr(value)
+                if elem is not None:
+                    local_types.setdefault(name, elem)
+                elif cm is not None:
+                    c = dotted_chain(value)
+                    if c and c.startswith("self.") and c.count(".") == 1:
+                        t = cm.attr_types.get(c.split(".", 1)[1])
+                        if t is not None:
+                            local_types.setdefault(name, t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                elem = elem_of_self_attr(node.iter)
+                if elem is None:
+                    continue
+                tgt = node.target
+                if isinstance(tgt, ast.Tuple) and tgt.elts and \
+                        isinstance(node.iter, ast.Call) and \
+                        isinstance(node.iter.func, ast.Name) and \
+                        node.iter.func.id == "enumerate":
+                    tgt = tgt.elts[-1]
+                if isinstance(tgt, ast.Name):
+                    local_types.setdefault(tgt.id, elem)
+        return local_locks, local_types
+
+    def _walk_scope(self, info: ModuleInfo, cm: Optional[ClassModel],
+                    label: str, fn: ast.AST,
+                    mlocks: dict[str, LockId]) -> None:
+        local_locks, local_types = self._local_model(info, cm, label, fn)
+        scope_key = (cm.key if cm is not None else info.name,
+                     label.split(".")[0])
+        calls_out = self.calls[info.name]
+
+        def lock_of(expr: ast.AST) -> Optional[LockId]:
+            chain = dotted_chain(expr)
+            if chain is None:
+                return None
+            parts = chain.split(".")
+            if parts[0] == "self" and cm is not None:
+                if len(parts) == 2 and parts[1] in cm.lock_attrs:
+                    return (cm.key, parts[1])
+                if len(parts) == 3:
+                    t = cm.attr_types.get(parts[1])
+                    tm = self.classes.get(t) if t else None
+                    if tm is not None and parts[2] in tm.lock_attrs:
+                        return (t, parts[2])
+            elif len(parts) == 1:
+                if parts[0] in local_locks:
+                    return local_locks[parts[0]]
+                if parts[0] in mlocks:
+                    return mlocks[parts[0]]
+            elif len(parts) == 2 and parts[0] in local_types:
+                t = local_types[parts[0]]
+                tm = self.classes.get(t)
+                if tm is not None and parts[1] in tm.lock_attrs:
+                    return (t, parts[1])
+            return None
+
+        def record_access(chain: str, write: bool, line: int,
+                          held: frozenset) -> None:
+            parts = chain.split(".")
+            owner: Optional[str] = None
+            attr: Optional[str] = None
+            if parts[0] == "self" and cm is not None:
+                if label.split(".")[0] == "__init__":
+                    return  # pre-publication: no other thread can see self yet
+                if len(parts) < 2:
+                    return
+                a = parts[1]
+                if a in cm.lock_attrs or a in cm.safe_attrs:
+                    return
+                if len(parts) == 2:
+                    if a in cm.methods:
+                        return
+                    owner, attr = cm.key, a
+                else:
+                    t = cm.attr_types.get(a)
+                    if t is None:
+                        return
+                    owner, attr, write = t, parts[2], \
+                        (write if len(parts) == 3 else False)
+            elif parts[0] in local_types and len(parts) >= 2:
+                t = local_types[parts[0]]
+                owner, attr, write = t, parts[1], \
+                    (write if len(parts) == 2 else False)
+            if owner is None or attr is None:
+                return
+            om = self.classes.get(owner)
+            if om is None or attr in om.lock_attrs or attr in om.safe_attrs \
+                    or attr in om.methods:
+                return
+            self.accesses.append(Access(
+                owner=owner, attr=attr, write=write,
+                cls=cm.key if cm is not None else info.name,
+                method=label.split(".")[0], label=label,
+                path=info.relpath, line=line, locks=held))
+
+        def scan_flat(stmt: ast.AST, held: frozenset) -> None:
+            for node in _walk_shallow(stmt):
+                if isinstance(node, ast.Attribute):
+                    chain = dotted_chain(node)
+                    if chain is not None:
+                        record_access(chain,
+                                      isinstance(node.ctx,
+                                                 (ast.Store, ast.Del)),
+                                      node.lineno, held)
+                elif isinstance(node, ast.Subscript) and \
+                        isinstance(node.ctx, (ast.Store, ast.Del)):
+                    chain = dotted_chain(node.value)
+                    if chain is not None:
+                        record_access(chain, True, node.lineno, held)
+                elif isinstance(node, ast.Call):
+                    calls_out.append(CallSite(
+                        node=node, held=held,
+                        target=self._call_target(info, cm, node, local_types),
+                        scope_key=scope_key,
+                        cls=cm.key if cm is not None else None,
+                        label=label, path=info.relpath, line=node.lineno))
+
+        def scan_body(body: list, held: frozenset) -> None:
+            for stmt in body:
+                if isinstance(stmt, FuncNode):
+                    # nested def: separate scope; locks held here are NOT
+                    # held when it eventually runs
+                    self._walk_scope(info, cm, f"{label}.{stmt.name}", stmt,
+                                     mlocks)
+                    continue
+                if isinstance(stmt, ast.ClassDef):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    scan_flat(stmt, held)
+                    new_held = set(held)
+                    for item in stmt.items:
+                        lid = lock_of(item.context_expr)
+                        if lid is None:
+                            continue
+                        self.acquires.append(Acquire(
+                            lock=lid, held=frozenset(new_held),
+                            scope_key=scope_key, label=label,
+                            path=info.relpath, line=stmt.lineno))
+                        new_held.add(lid)
+                    scan_body(stmt.body, frozenset(new_held))
+                elif isinstance(stmt, ast.If):
+                    scan_flat(stmt, held)
+                    scan_body(stmt.body, held)
+                    scan_body(stmt.orelse, held)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                    scan_flat(stmt, held)
+                    scan_body(stmt.body, held)
+                    scan_body(stmt.orelse, held)
+                elif isinstance(stmt, ast.Try):
+                    scan_body(stmt.body, held)
+                    for h in stmt.handlers:
+                        scan_body(h.body, held)
+                    scan_body(stmt.orelse, held)
+                    scan_body(stmt.finalbody, held)
+                else:
+                    scan_flat(stmt, held)
+
+        scan_body(fn.body, frozenset())
+
+    def _call_target(self, info: ModuleInfo, cm: Optional[ClassModel],
+                     call: ast.Call,
+                     local_types: dict[str, str]) -> Optional[tuple]:
+        if isinstance(call.func, ast.Attribute):
+            chain = dotted_chain(call.func)
+            if chain is not None:
+                parts = chain.split(".")
+                if parts[0] == "self" and cm is not None:
+                    if len(parts) == 2 and parts[1] in cm.methods:
+                        return (cm.key, parts[1])
+                    if len(parts) == 3:
+                        t = cm.attr_types.get(parts[1])
+                        tm = self.classes.get(t) if t else None
+                        if tm is not None and parts[2] in tm.methods:
+                            return (t, parts[2])
+                elif len(parts) == 2 and parts[0] in local_types:
+                    t = local_types[parts[0]]
+                    tm = self.classes.get(t)
+                    if tm is not None and parts[1] in tm.methods:
+                        return (t, parts[1])
+        resolved = self.index.resolve_call(info, call)
+        return resolved  # (module, top-level fn) or None
+
+    # -- fixpoints ------------------------------------------------------------
+
+    def _intra_edges(self, cm: ClassModel) -> list[tuple[str, str, frozenset]]:
+        out = []
+        for site in self.calls.get(cm.info.name, ()):
+            if site.cls == cm.key and site.target is not None and \
+                    site.target[0] == cm.key and site.target[1] in cm.methods:
+                out.append((site.label.split(".")[0], site.target[1],
+                            site.held))
+        return out
+
+    def _compute_roots(self, cm: ClassModel) -> None:
+        roots: dict[str, set] = {}
+        for m in cm.methods:
+            r: set = set()
+            if m in cm.entries:
+                r.add(f"{cm.name}.{m}")
+            elif _is_public(m) and m != "__init__":
+                r.add(MAIN_ROOT)
+            roots[m] = r
+        edges = [(c, t) for c, t, _ in self._intra_edges(cm)
+                 if c != "__init__"]
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee in edges:
+                if caller in roots and callee in roots:
+                    new = roots[callee] | roots[caller]
+                    if new != roots[callee]:
+                        roots[callee] = new
+                        changed = True
+        cm.roots = {m: frozenset(r) if r else frozenset({MAIN_ROOT})
+                    for m, r in roots.items()}
+
+    def _compute_guaranteed(self, cm: ClassModel) -> None:
+        """Locks guaranteed held on EVERY path into each method: entry/public
+        methods start with none; a private helper inherits the intersection
+        over all intra-class call sites (held-at-site | caller's guarantee).
+        Resolves the ``with self._lock: self._helper()`` shape through the
+        call graph."""
+        sites = self._intra_edges(cm)
+        fixed = {m for m in cm.methods
+                 if m in cm.entries or _is_public(m) or m == "__init__"}
+        g: dict[str, Optional[frozenset]] = {
+            m: (frozenset() if m in fixed else None) for m in cm.methods}
+        for _ in range(len(cm.methods) + 2):
+            changed = False
+            for m in cm.methods:
+                if m in fixed:
+                    continue
+                cands = [held | g[caller]
+                         for caller, callee, held in sites
+                         if callee == m and g.get(caller) is not None]
+                if not cands:
+                    continue
+                new = frozenset.intersection(*cands)
+                if g[m] != new:
+                    g[m] = new
+                    changed = True
+            if not changed:
+                break
+        cm.guaranteed = {m: (v if v is not None else frozenset())
+                         for m, v in g.items()}
+
+    def _transitive_acquires(self) -> dict[tuple, set]:
+        direct: dict[tuple, set] = {}
+        for a in self.acquires:
+            direct.setdefault(a.scope_key, set()).add(a.lock)
+        call_edges: dict[tuple, set] = {}
+        for sites in self.calls.values():
+            for s in sites:
+                if s.target is not None:
+                    call_edges.setdefault(s.scope_key, set()).add(
+                        tuple(s.target))
+        tacq = {k: set(v) for k, v in direct.items()}
+        for _ in range(len(call_edges) + 2):
+            changed = False
+            for scope, targets in call_edges.items():
+                cur = tacq.setdefault(scope, set())
+                for t in targets:
+                    extra = tacq.get(t, set()) - cur
+                    if extra:
+                        cur |= extra
+                        changed = True
+            if not changed:
+                break
+        return tacq
+
+    # -- shared helpers for the checkers -------------------------------------
+
+    def effective_locks(self, a: Access) -> frozenset:
+        cm = self.classes.get(a.cls)
+        if cm is None:
+            return a.locks
+        return a.locks | cm.guaranteed.get(a.method, frozenset())
+
+    def roots_of(self, a: Access) -> frozenset:
+        cm = self.classes.get(a.cls)
+        if cm is None:
+            return frozenset({MAIN_ROOT})
+        return cm.roots.get(a.method, frozenset({MAIN_ROOT}))
+
+    def lock_name(self, lid: LockId) -> str:
+        return f"{lid[0].split('.')[-1]}.{lid[1]}"
+
+
+def _lockset_str(conc: ConcurrencyIndex, locks: frozenset) -> str:
+    if not locks:
+        return "no lock"
+    return "{" + ", ".join(sorted(conc.lock_name(l) for l in locks)) + "}"
+
+
+# ---------------------------------------------------------------------------
+# DCR011 — unguarded shared state across thread roots
+# ---------------------------------------------------------------------------
+
+def check_dcr011(conc: ConcurrencyIndex) -> list[Finding]:
+    by_state: dict[tuple[str, str], list[Access]] = {}
+    for a in conc.accesses:
+        acc_cls = conc.classes.get(a.cls)
+        if acc_cls is None or not acc_cls.entries:
+            # a class with no thread entries gives us no root attribution:
+            # its methods run on whatever thread calls them
+            continue
+        by_state.setdefault((a.owner, a.attr), []).append(a)
+    out: list[Finding] = []
+    for (owner, attr), accs in sorted(by_state.items()):
+        om = conc.classes.get(owner)
+        if om is None:
+            continue
+        writes = [a for a in accs if a.write]
+        if not writes:
+            continue  # read-only (or append-only) after construction
+        best: Optional[tuple] = None
+        for w in writes:
+            ew = conc.effective_locks(w)
+            rw = conc.roots_of(w)
+            for a in accs:
+                if a is w:
+                    continue
+                ra = conc.roots_of(a)
+                if len(rw | ra) < 2:
+                    continue  # every involved site runs on one thread root
+                ea = conc.effective_locks(a)
+                if ew & ea:
+                    continue  # a common lock serializes the pair
+                score = (len(ew) + len(ea), w.line, a.line)
+                if best is None or score < best[0]:
+                    best = (score, w, a, ew, ea)
+        if best is None:
+            continue
+        _, w, a, ew, ea = best
+        info = conc.classes[w.cls].info
+        out.append(_finding(
+            info, "DCR011", _line_node(w.line),
+            f"shared attribute '{om.name}.{attr}' is written in "
+            f"{_site(conc, w)} holding {_lockset_str(conc, ew)} and "
+            f"{'written' if a.write else 'read'} in {_site(conc, a)} "
+            f"(at {a.path}:{a.line}) holding {_lockset_str(conc, ea)} — "
+            "the two sites run on different thread roots with no common "
+            "lock; guard both with one lock or confine the attribute to a "
+            "single thread"))
+    return out
+
+
+def _line_node(line: int) -> ast.AST:
+    node = ast.Pass()
+    node.lineno = line
+    node.col_offset = 0
+    return node
+
+
+def _site(conc: ConcurrencyIndex, a: Access) -> str:
+    roots = ", ".join(sorted(conc.roots_of(a)))
+    cls = a.cls.split(".")[-1]
+    return f"{cls}.{a.label} [thread root: {roots}]"
+
+
+# ---------------------------------------------------------------------------
+# DCR012 — lock-order inversion
+# ---------------------------------------------------------------------------
+
+def check_dcr012(conc: ConcurrencyIndex) -> list[Finding]:
+    # edge (h -> l): some code path acquires l while holding h
+    edges: dict[LockId, dict[LockId, tuple[str, int, str]]] = {}
+
+    def add_edge(h: LockId, l: LockId, path: str, line: int,
+                 desc: str) -> None:
+        edges.setdefault(h, {}).setdefault(l, (path, line, desc))
+
+    out: list[Finding] = []
+    for a in conc.acquires:
+        for h in a.held:
+            if h == a.lock:
+                continue
+            add_edge(h, a.lock, a.path, a.line,
+                     f"{a.label} acquires {conc.lock_name(a.lock)} while "
+                     f"holding {conc.lock_name(h)}")
+        if a.lock in a.held and conc.lock_kinds.get(a.lock) == "lock":
+            out.append(Finding(
+                rule="DCR012", path=a.path, line=a.line, col=0,
+                message=(
+                    f"{a.label} re-acquires {conc.lock_name(a.lock)} while "
+                    "already holding it — a non-reentrant threading.Lock "
+                    "deadlocks its own thread here; use an RLock or drop "
+                    "the inner with"),
+                snippet=_snippet(conc, a.path, a.line)))
+    for sites in conc.calls.values():
+        for s in sites:
+            if not s.held or s.target is None:
+                continue
+            for l in conc.tacq.get(tuple(s.target), ()):
+                for h in s.held:
+                    if l == h:
+                        continue
+                    add_edge(h, l, s.path, s.line,
+                             f"{s.label} calls {_target_str(s.target)} "
+                             f"(which acquires {conc.lock_name(l)}) while "
+                             f"holding {conc.lock_name(h)}")
+
+    # interprocedural re-entry of a non-reentrant Lock: with self._lock: a call
+    # path that re-acquires self._lock deadlocks the calling thread itself
+    for sites in conc.calls.values():
+        for s in sites:
+            if not s.held or s.target is None:
+                continue
+            for l in conc.tacq.get(tuple(s.target), ()):
+                if l in s.held and conc.lock_kinds.get(l) == "lock":
+                    out.append(Finding(
+                        rule="DCR012", path=s.path, line=s.line, col=0,
+                        message=(
+                            f"{s.label} calls {_target_str(s.target)} while "
+                            f"holding {conc.lock_name(l)}, and that call "
+                            f"path re-acquires {conc.lock_name(l)} — a "
+                            "non-reentrant threading.Lock deadlocks its own "
+                            "thread here; use an RLock or split the locked "
+                            "helper"),
+                        snippet=_snippet(conc, s.path, s.line)))
+
+    seen: set[tuple] = set()
+    for start in sorted(edges):
+        stack: list[tuple[LockId, list[LockId]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(edges.get(node, {})):
+                if nxt == start and len(path) > 1:
+                    cyc = tuple(path)
+                    rot = cyc.index(min(cyc))
+                    canon = cyc[rot:] + cyc[:rot]
+                    if canon in seen:
+                        continue
+                    seen.add(canon)
+                    legs = []
+                    for i, lid in enumerate(path):
+                        succ = path[(i + 1) % len(path)]
+                        wp, wl, wd = edges[lid][succ]
+                        legs.append(f"[{wp}:{wl}] {wd}")
+                    wp0, wl0, _ = edges[path[0]][path[1 % len(path)]]
+                    names = " -> ".join(conc.lock_name(l)
+                                        for l in path + [path[0]])
+                    out.append(Finding(
+                        rule="DCR012", path=wp0, line=wl0, col=0,
+                        message=(
+                            f"lock-order inversion {names}: two threads "
+                            "taking these locks in opposite orders deadlock. "
+                            "Witness paths: " + "; ".join(legs) +
+                            " — pick one global order and acquire in it "
+                            "everywhere"),
+                        snippet=_snippet(conc, wp0, wl0)))
+                elif nxt not in path and nxt > start:
+                    # canonical enumeration: only walk cycles whose minimal
+                    # node is the current start, so each cycle fires once
+                    stack.append((nxt, path + [nxt]))
+    out.sort(key=lambda f: (f.path, f.line))
+    return out
+
+
+def _target_str(target: tuple) -> str:
+    return f"{target[0].split('.')[-1]}.{target[1]}()"
+
+
+def _snippet(conc: ConcurrencyIndex, path: str, line: int) -> str:
+    for info in conc.index.modules.values():
+        if info.relpath == path:
+            return info.analysis.line(line).strip()
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# DCR013 — blocking call under a held lock (hot paths)
+# ---------------------------------------------------------------------------
+
+_BLOCKING_DIRECT = {
+    "time.sleep", "os.fsync", "os.fdatasync",
+    "socket.create_connection", "urllib.request.urlopen",
+    "jax.block_until_ready",
+}
+_BLOCKING_METHODS = {"getresponse", "block_until_ready", "sendall", "recv",
+                     "accept", "urlopen"}
+
+
+def check_dcr013(conc: ConcurrencyIndex, info: ModuleInfo,
+                 cfg: CheckConfig) -> list[Finding]:
+    if not cfg.in_hot_path(info.relpath):
+        return []
+    tracked = tracked_sync_chains(info)
+    out: list[Finding] = []
+    for site in conc.calls.get(info.name, ()):
+        if not site.held:
+            continue
+        node = site.node
+        label: Optional[str] = None
+        resolved = info.resolve_call_name(node)
+        if resolved in _BLOCKING_DIRECT:
+            label = f"{resolved}()"
+        elif isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv = dotted_chain(node.func.value)
+            expect = tracked.get(recv) if recv is not None else None
+            if expect is not None and \
+                    (attr == expect or
+                     (expect == "wait" and attr == "wait_for")):
+                if not _bounded_wait(node, attr):
+                    label = f"{recv}.{attr}() (untimed)"
+            elif attr == "result" and recv is not None and \
+                    recv.split(".")[-1] in _FUTURE_RECEIVERS:
+                if not _bounded_wait(node, "result"):
+                    label = f"{recv}.result() (untimed)"
+            elif attr in _BLOCKING_METHODS:
+                label = f"{recv or '<expr>'}.{attr}()"
+        if label is None:
+            continue
+        locks = _lockset_str(conc, site.held)
+        out.append(_finding(
+            info, "DCR013", node,
+            f"{label} inside a region holding {locks} on a hot path — every "
+            "other thread contending for the lock stalls behind this "
+            "blocking call; move it outside the critical section or bound "
+            "it with a timeout"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DCR015 — leaked thread handles
+# ---------------------------------------------------------------------------
+
+def check_dcr015(info: ModuleInfo) -> list[Finding]:
+    analysis = info.analysis
+    out: list[Finding] = []
+    for node in ast.walk(analysis.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = info.resolve_call_name(node)
+        if resolved not in _THREAD_CONSTRUCTORS:
+            continue
+        parent = analysis.parent.get(node)
+        if isinstance(parent, ast.Attribute) and parent.attr == "start":
+            out.append(_finding(
+                info, "DCR015", node,
+                "Thread(...).start() discards the handle — nothing can ever "
+                "join this thread or observe its death; store it on self "
+                "(or a local joined on the shutdown path)"))
+            continue
+        if not isinstance(parent, (ast.Assign, ast.AnnAssign)):
+            continue  # passed/stored into an expression: it escapes
+        targets = parent.targets if isinstance(parent, ast.Assign) \
+            else [parent.target]
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            continue  # self.x = Thread(...) (kept) / unpacking (give up)
+        name = targets[0].id
+        scope = analysis.enclosing_scope(node)
+        body = scope.body if not isinstance(scope, ast.Module) \
+            else analysis.tree.body
+        started = joined = escaped = False
+        for n in _scope_walk(body):
+            if isinstance(n, ast.Name) and n.id == name and \
+                    isinstance(n.ctx, ast.Load):
+                p = analysis.parent.get(n)
+                if isinstance(p, ast.Attribute):
+                    if p.attr == "start":
+                        started = True
+                    elif p.attr == "join":
+                        joined = True
+                else:
+                    escaped = True  # returned / appended / passed along
+        if started and not joined and not escaped:
+            out.append(_finding(
+                info, "DCR015", node,
+                f"thread handle '{name}' is started but never joined and "
+                "never escapes this scope — the thread outlives every "
+                "reference to it; join it on the shutdown path or store it "
+                "where shutdown can"))
+    return out

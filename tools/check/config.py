@@ -33,6 +33,20 @@ DEFAULT_HOT_PATHS = (
     "dcr_tpu/cli/serve.py",
     "dcr_tpu/core/coordination.py",
     "dcr_tpu/core/dist.py",
+    "dcr_tpu/search/livestore.py",
+)
+# modules under the WAL fsync-before-ack contract (DCR014 leg 2)
+DEFAULT_WAL_MODULES = (
+    "dcr_tpu/search/livestore.py",
+)
+# telemetry / fault-injection sinks: their file writes are best-effort
+# streams (trace logs, flight-recorder dumps, chaos seals), not payload a
+# calling scope is publishing — excluded from DCR014's write closure so a
+# log line doesn't read as an unsynced WAL record
+DEFAULT_BEST_EFFORT_WRITERS = (
+    "dcr_tpu.core.tracing",
+    "dcr_tpu.core.resilience",
+    "dcr_tpu.utils.faults",
 )
 
 
@@ -46,8 +60,13 @@ class CheckConfig:
     # block before the budget diff fails (``memory-tolerance`` in
     # [tool.dcr-check]; --memory-tolerance overrides per run)
     memory_tolerance: float = 0.10
+    wal_modules: tuple[str, ...] = DEFAULT_WAL_MODULES
+    best_effort_writers: tuple[str, ...] = DEFAULT_BEST_EFFORT_WRITERS
     exclude: tuple[str, ...] = ("__pycache__",)
     root: Path = field(default_factory=Path)
+
+    def is_wal_module(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/") in set(self.wal_modules)
 
     def in_hot_path(self, relpath: str) -> bool:
         posix = relpath.replace("\\", "/")
@@ -78,6 +97,9 @@ def load_check_config(pyproject: Optional[Path] = None,
         hot_paths=tuple(section.get("hot-paths", DEFAULT_HOT_PATHS)),
         manifest=section.get("manifest", "compile_manifest.json"),
         memory_tolerance=float(section.get("memory-tolerance", 0.10)),
+        wal_modules=tuple(section.get("wal-modules", DEFAULT_WAL_MODULES)),
+        best_effort_writers=tuple(section.get(
+            "best-effort-writers", DEFAULT_BEST_EFFORT_WRITERS)),
         exclude=tuple(section.get("exclude", ("__pycache__",))),
         root=pyproject.parent,
     )

@@ -1,0 +1,263 @@
+"""dcr-check durability rule: DCR014 torn-publish / ack-before-fsync.
+
+The repo's crash-safety story rests on ~20 ``os.replace`` atomic-publish
+sites (WAL segments, store manifests, warm-cache entries, latent-cache
+shards, checkpoint manifests) plus the livestore's fsync-before-ack
+contract, dynamically exercised by the SIGKILL chaos e2e. This rule proves
+the ordering statically at every site:
+
+- **leg 1 — torn publish**: an ``os.replace`` / ``os.rename`` (or
+  ``Path.replace`` / ``Path.rename``) preceded in its scope by a file write
+  (direct ``.write*()`` call, a serializer like ``json.dump`` /
+  ``np.save``, or a helper that transitively writes — resolved through the
+  call graph) with **no** ``os.fsync`` before the rename. The rename is
+  atomic in the namespace but says nothing about the data blocks: a power
+  cut after the rename can leave a sha-valid *name* pointing at torn
+  bytes. Pure renames (rotation, quarantine — no write feeding them) are
+  exempt.
+- **leg 2 — ack before fsync**: in WAL-marked modules
+  (``[tool.dcr-check] wal-modules``), a scope whose last file ``.write()``
+  is not followed by an ``os.fsync`` — the caller can be acked a record
+  that never reached disk. ``io.BytesIO`` staging buffers and ``sys.*``
+  streams are exempt.
+
+Like the rest of dcr-check this is stdlib-only, name-based and
+precision-biased: helpers are resolved same-module by name and
+cross-module through the top-level call graph; anything dynamic is skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Optional
+
+from tools.lint.analysis import FuncNode, ModuleAnalysis
+from tools.lint.rules import Finding
+from tools.check.config import CheckConfig
+from tools.check.graph import ModuleInfo, ProgramIndex, dotted_chain
+from tools.check.rules import _finding
+
+_RENAME_FNS = {"os.replace", "os.rename", "shutil.move"}
+_FSYNC_FNS = {"os.fsync", "os.fdatasync"}
+_WRITE_METHODS = {"write", "write_bytes", "write_text", "writelines"}
+_WRITE_FNS = {
+    "json.dump", "pickle.dump", "numpy.save", "numpy.savez",
+    "numpy.savez_compressed", "shutil.copy", "shutil.copyfile",
+    "shutil.copy2", "shutil.copyfileobj",
+}
+_EXEMPT_RECV_HEADS = {"sys", "logging"}
+
+
+def _all_defs(index: ProgramIndex):
+    for info in index.modules.values():
+        for node in ast.walk(info.analysis.tree):
+            if isinstance(node, FuncNode):
+                yield info, node
+
+
+def _transitive_fns(index: ProgramIndex,
+                    seed: Callable[[ModuleInfo, ast.Call], bool],
+                    exempt_modules: frozenset[str] = frozenset()
+                    ) -> set[tuple[str, str]]:
+    """(module, function-name) keys of every def that directly satisfies
+    ``seed`` or calls one that does — same-module helpers matched by name
+    (covers methods), cross-module through the top-level call graph.
+    Defs in ``exempt_modules`` are never marked (and so never propagate)."""
+    defs = list(_all_defs(index))
+    marked: set[tuple[str, str]] = set()
+    for _ in range(len(defs) + 2):
+        changed = False
+        for info, fn in defs:
+            key = (info.name, fn.name)
+            if key in marked or info.name in exempt_modules:
+                continue
+            buffers = frozenset(_bytesio_locals(info, fn.body))
+            # deep_calls prunes at FuncNode (incl. the root), so walk the
+            # def's own body statements
+            for call in (c for stmt in fn.body
+                         for c in ModuleAnalysis.deep_calls(stmt)):
+                if seed(info, call, buffers):
+                    marked.add(key)
+                    changed = True
+                    break
+                local = _local_target_name(call)
+                if local is not None and (info.name, local) in marked:
+                    marked.add(key)
+                    changed = True
+                    break
+                target = index.resolve_call(info, call)
+                if target is not None and tuple(target) in marked:
+                    marked.add(key)
+                    changed = True
+                    break
+        if not changed:
+            break
+    return marked
+
+
+def _local_target_name(call: ast.Call) -> Optional[str]:
+    """Name a call could resolve to *in this module*: a bare call
+    (``helper(..)``) or a method on self/cls (``self._roll()``). An
+    arbitrary receiver (``self._tail.append(..)``) must NOT name-match a
+    module's own ``append`` method."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id in ("self", "cls"):
+        return f.attr
+    return None
+
+
+def _seed_fsync(info: ModuleInfo, call: ast.Call,
+                buffers: frozenset = frozenset()) -> bool:
+    return info.resolve_call_name(call) in _FSYNC_FNS
+
+
+def _staged(call: ast.Call, buffers) -> bool:
+    """True when the write targets an in-memory staging buffer, either as
+    the method receiver (``buf.write(..)``) or as a serializer argument
+    (``np.savez(buf, ..)``, ``json.dump(doc, buf)``)."""
+    recvs = []
+    if isinstance(call.func, ast.Attribute):
+        recvs.append(dotted_chain(call.func.value))
+    recvs.extend(dotted_chain(a) for a in call.args)
+    return any(r in buffers for r in recvs if r is not None)
+
+
+def _seed_write(info: ModuleInfo, call: ast.Call,
+                buffers: frozenset = frozenset()) -> bool:
+    if info.resolve_call_name(call) in _WRITE_FNS:
+        return not _staged(call, buffers)
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in _WRITE_METHODS:
+        recv = dotted_chain(call.func.value)
+        if recv is not None and recv.split(".")[0] in _EXEMPT_RECV_HEADS:
+            return False
+        return not _staged(call, buffers)
+    return False
+
+
+class FsyncIndex:
+    """Shared closure results, built once per program scan."""
+
+    def __init__(self, index: ProgramIndex,
+                 exempt_writers: tuple[str, ...] = ()):
+        self.index = index
+        self.fsyncing = _transitive_fns(index, _seed_fsync)
+        self.writing = _transitive_fns(index, _seed_write,
+                                       frozenset(exempt_writers))
+
+    def _is_marked(self, info: ModuleInfo, call: ast.Call,
+                   marked: set[tuple[str, str]]) -> bool:
+        local = _local_target_name(call)
+        if local is not None and (info.name, local) in marked:
+            return True
+        target = self.index.resolve_call(info, call)
+        return target is not None and tuple(target) in marked
+
+    def call_fsyncs(self, info: ModuleInfo, call: ast.Call) -> bool:
+        return _seed_fsync(info, call) or \
+            self._is_marked(info, call, self.fsyncing)
+
+    def call_writes(self, info: ModuleInfo, call: ast.Call) -> bool:
+        return _seed_write(info, call) or \
+            self._is_marked(info, call, self.writing)
+
+
+def _rename_dest(info: ModuleInfo, call: ast.Call) -> str:
+    args = call.args
+    target = args[1] if len(args) >= 2 else (args[0] if args else None)
+    if target is None:
+        return "the destination"
+    c = dotted_chain(target)
+    return f"'{c}'" if c else "the destination"
+
+
+def _is_rename(info: ModuleInfo, call: ast.Call) -> bool:
+    resolved = info.resolve_call_name(call)
+    if resolved in _RENAME_FNS:
+        return True
+    # Path.replace(dest) / Path.rename(dest): exactly one positional arg
+    # distinguishes it from str.replace(old, new)
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in ("replace", "rename") and \
+            len(call.args) == 1 and not call.keywords:
+        return True
+    return False
+
+
+def _bytesio_locals(info: ModuleInfo, body: list) -> set[str]:
+    out: set[str] = set()
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and \
+                isinstance(getattr(node, "value", None), ast.Call):
+            resolved = info.resolve_call_name(node.value)
+            if resolved in ("io.BytesIO", "io.StringIO"):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    c = dotted_chain(t)
+                    if c is not None:
+                        out.add(c)
+        if isinstance(node, FuncNode) or isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_dcr014(index: ProgramIndex, info: ModuleInfo, cfg: CheckConfig,
+                 fsync_index: Optional[FsyncIndex] = None) -> list[Finding]:
+    fsx = fsync_index or FsyncIndex(index)
+    analysis = info.analysis
+    out: list[Finding] = []
+    wal = cfg.is_wal_module(info.relpath)
+    for scope, body in analysis.scopes():
+        buffers = _bytesio_locals(info, body)
+        writes: list[int] = []       # real (non-staging) file writes
+        any_writes: list[int] = []   # any write incl. staging buffers
+        fsyncs: list[int] = []
+        renames: list[tuple[int, ast.Call]] = []
+        for ls in analysis.linearize(body):
+            for call in analysis.stmt_calls(ls.stmt):
+                line = call.lineno
+                if _is_rename(info, call):
+                    renames.append((line, call))
+                    continue
+                if fsx.call_fsyncs(info, call):
+                    fsyncs.append(line)
+                    continue
+                if _seed_write(info, call):
+                    any_writes.append(line)
+                    if _seed_write(info, call, frozenset(buffers)):
+                        writes.append(line)
+                elif fsx.call_writes(info, call):
+                    any_writes.append(line)
+                    writes.append(line)
+        for line, call in renames:
+            if not any(w < line for w in any_writes):
+                continue  # pure rename: rotation/quarantine, no data written
+            if any(s < line for s in fsyncs):
+                continue
+            out.append(_finding(
+                info, "DCR014", call,
+                f"atomic publish of {_rename_dest(info, call)} renames a "
+                "temp file whose bytes were never fsynced — the rename is "
+                "atomic in the namespace only, so a power cut can leave a "
+                "committed name with torn contents; flush() + "
+                "os.fsync(fileno) before the rename (and fsync the "
+                "directory if ordering against a manifest matters)"))
+        if wal and writes:
+            last_write = max(writes)
+            if not fsyncs or max(fsyncs) < last_write:
+                node = ast.Pass()
+                node.lineno, node.col_offset = last_write, 0
+                out.append(_finding(
+                    info, "DCR014", node,
+                    "WAL-marked module: this scope's last file write is "
+                    "never followed by os.fsync — the caller can be acked a "
+                    "record that exists only in the page cache and vanishes "
+                    "on power loss; fsync before returning/acking"))
+    return out

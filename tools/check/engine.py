@@ -17,10 +17,15 @@ from pathlib import Path
 from typing import Optional
 
 from tools.lint.config import load_config as load_lint_config
-from tools.lint.engine import Report, _pragma_rules, scan
+from tools.lint.engine import Report, _pragma_rules, load_baseline, scan
+from tools.lint.rules import RULES as LINT_RULES
 from tools.lint.rules import Finding
 
+from tools.check.concurrency import (ConcurrencyIndex, check_dcr011,
+                                     check_dcr012, check_dcr013,
+                                     check_dcr015)
 from tools.check.config import CheckConfig, load_check_config
+from tools.check.durability import FsyncIndex, check_dcr014
 from tools.check.graph import ProgramIndex, load_program
 from tools.check.rules import (check_dcr009, check_dcr010,
                                check_manifest_coverage, check_x002,
@@ -65,6 +70,8 @@ def scan_program(cfg: CheckConfig, *,
     """(findings, pragma-suppressed, modules analyzed) for the whole-program
     layer. Stdlib-only — safe on a bare checkout."""
     index = load_program(cfg.root, cfg.roots, cfg.exclude)
+    conc = ConcurrencyIndex(index)
+    fsx = FsyncIndex(index, exempt_writers=cfg.best_effort_writers)
     raw: list[Finding] = []
     for info in index.modules.values():
         raw.extend(check_x002(index, info))
@@ -73,6 +80,16 @@ def scan_program(cfg: CheckConfig, *,
         if cfg.in_hot_path(info.relpath):
             raw.extend(check_dcr009(info))
         raw.extend(check_dcr010(index, info, cfg))
+        raw.extend(check_dcr013(conc, info, cfg))
+        raw.extend(check_dcr014(index, info, cfg, fsync_index=fsx))
+        raw.extend(check_dcr015(info))
+    raw.extend(check_dcr011(conc))
+    raw.extend(check_dcr012(conc))
+    # DCR013 subsumes DCR009 at the same site (the lock makes it strictly
+    # worse): drop the DCR009 duplicate so one hazard reports once
+    dcr013_sites = {(f.path, f.line) for f in raw if f.rule == "DCR013"}
+    raw = [f for f in raw
+           if not (f.rule == "DCR009" and (f.path, f.line) in dcr013_sites)]
     mpath = manifest_path if manifest_path is not None \
         else cfg.root / cfg.manifest
     raw.extend(check_manifest_coverage(index, cfg, mpath))
@@ -104,13 +121,47 @@ def run_layer1(cfg: Optional[CheckConfig] = None, *,
     findings with its own annotations, and re-reporting them here would
     double every inline ::error on the PR diff."""
     cfg = cfg or load_check_config(pyproject=pyproject)
+    lint_cfg = load_lint_config(pyproject=pyproject, start=cfg.root)
     if include_local:
-        lint_cfg = load_lint_config(pyproject=pyproject, start=cfg.root)
         local = scan([cfg.root / p for p in lint_paths], lint_cfg)
     else:
         local = Report()
     program, suppressed, n_modules = scan_program(
         cfg, manifest_path=manifest_path)
+    # program-layer findings honor the same justified baseline as the
+    # file-local layer: one suppression surface for both
+    bl_path = cfg.root / lint_cfg.baseline if lint_cfg.baseline else None
+    if bl_path is not None:
+        entries = load_baseline(Path(bl_path))
+        budget = [int(e.get("count", 1)) for e in entries]
+        kept: list[Finding] = []
+        matched: set[tuple[str, str, str]] = set()
+        for f in program:
+            hit = False
+            for i, entry in enumerate(entries):
+                key = (entry["rule"], entry["path"], entry["snippet"])
+                if budget[i] > 0 and key == f.key():
+                    budget[i] -= 1
+                    matched.add(key)
+                    hit = True
+                    break
+            if hit:
+                local.baseline_suppressed += 1
+            else:
+                kept.append(f)
+        program = kept
+        # an entry the program layer consumed is not stale, whatever the
+        # file-local scan (which never emits these rules) concluded
+        local.stale_baseline = [
+            e for e in local.stale_baseline
+            if (e["rule"], e["path"], e["snippet"]) not in matched]
+        # the file-local layer refuses to judge entries for rules outside
+        # its registry — this layer runs them, so an entry the program
+        # scan never matched IS stale and must be reported here
+        local.stale_baseline.extend(
+            e for e in entries
+            if e["rule"] not in LINT_RULES
+            and (e["rule"], e["path"], e["snippet"]) not in matched)
     return CheckReport(local=local, program=program,
                        pragma_suppressed=suppressed,
                        modules_analyzed=n_modules)

@@ -370,10 +370,11 @@ def _bounded_wait(call: ast.Call, method: str) -> bool:
     return len(call.args) >= 1 and not _is_unbounded_const(call.args[0])
 
 
-def check_dcr009(info: ModuleInfo) -> list[Finding]:
+def tracked_sync_chains(info: ModuleInfo) -> dict[str, str]:
+    """chain -> blocking method for names/attr chains bound (anywhere in the
+    module — __init__ vs worker-loop methods) to a Queue/Event/Thread/
+    Condition/Barrier constructor result. Shared by DCR009 and DCR013."""
     analysis = info.analysis
-    # chains bound (anywhere in the module — __init__ vs worker-loop methods)
-    # to a Queue/Event/Thread/Condition/Barrier constructor result
     tracked: dict[str, str] = {}
     for node in ast.walk(analysis.tree):
         if not isinstance(node, (ast.Assign, ast.AnnAssign)):
@@ -391,6 +392,12 @@ def check_dcr009(info: ModuleInfo) -> list[Finding]:
             c = dotted_chain(t)
             if c is not None:
                 tracked[c] = method
+    return tracked
+
+
+def check_dcr009(info: ModuleInfo) -> list[Finding]:
+    analysis = info.analysis
+    tracked = tracked_sync_chains(info)
     out: list[Finding] = []
     for node in ast.walk(analysis.tree):
         if not isinstance(node, ast.Call) or \

@@ -279,9 +279,13 @@ def scan(paths: Sequence[str | Path], cfg: Optional[LintConfig] = None, *,
     # an entry is stale when its file WAS scanned and nothing matched —
     # partial scans (one file, a subdir) must not cry wolf about the rest —
     # or when its file no longer exists at all: a deleted file can never
-    # match any scan, so keeping its entry around only hides baseline rot
+    # match any scan, so keeping its entry around only hides baseline rot.
+    # Entries for rules outside this layer's registry (the program-layer
+    # DCR01x rules) are never judged here: only `python -m tools.check`,
+    # which runs those rules, can tell whether they still match.
     report.stale_baseline = [e for i, e in enumerate(entries)
                              if i not in matched_entries
+                             and e["rule"] in RULES
                              and (e["path"] in scanned_rel
                                   or not (cfg.root / e["path"]).is_file())]
     report.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
