@@ -13,11 +13,20 @@ Subcommands:
     query     --store_dir=... --gen_folder=... --out_path=... [--top_k=K]
               [--query_batch=B] [--segment_rows=R] [--warm_dir=...]
               [--live=true]              # include the WAL live tail (dcr-live)
+              [--ann=true --nprobe=N]    # IVF tier instead of exact scan
     recover   --store_dir=...            # replay the WAL: truncate torn
                                          # tails, reload acked rows, print
                                          # the recovery report
     compact   --store_dir=...            # recover, then fold the WAL into
                                          # committed shards + new snapshot
+                                         # (+ incremental IVF list folds)
+    train-ivf --store_dir=... [--n_lists=L] [--ivf_iters=I] [--ivf_seed=S]
+              [--ivf_train_rows=N] [--ivf_normalize=true] [--warm_dir=...]
+                                         # train the IVF quantizer + commit
+                                         # int8 inverted lists (dcr-ann)
+    stats     --store_dir=... [--json_out=true]
+                                         # committed + live + ann tier
+                                         # summary for fleet runbooks
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ from dcr_tpu.search import embed as E
 from dcr_tpu.search import search as S
 
 USAGE = ("usage: dcr-search {download|embed|search|build|append|verify|query"
-         "|recover|compact} --key=value ...")
+         "|recover|compact|train-ivf|stats} --key=value ...")
 
 
 def _store_sources(cfg: SearchConfig) -> list:
@@ -95,6 +104,75 @@ def _cmd_recover(cfg: SearchConfig, compact: bool) -> None:
     print(json.dumps(report, indent=1, sort_keys=True))
 
 
+def _cmd_train_ivf(cfg: SearchConfig) -> None:
+    from dcr_tpu.search import ann
+
+    if not cfg.store_dir:
+        raise SystemExit("train-ivf needs --store_dir=<built store>")
+    report = ann.train_ivf(
+        cfg.store_dir, n_lists=cfg.n_lists, iters=cfg.ivf_iters,
+        seed=cfg.ivf_seed, train_rows=cfg.ivf_train_rows,
+        normalize=cfg.ivf_normalize, warm_dir=cfg.warm_dir)
+    print(json.dumps(report, indent=1, sort_keys=True))
+
+
+def store_stats(store_dir: str) -> dict:
+    """Committed + live + ann tier summary (read-only, never quarantines)
+    — the ``dcr-search stats`` payload, importable for tests/runbooks."""
+    from dcr_tpu.search import ann
+    from dcr_tpu.search.store import read_store_manifest
+
+    manifest = read_store_manifest(Path(store_dir), quarantine=False)
+    report: dict = {"store_dir": str(store_dir), "committed": {
+        "snapshot": int(manifest.get("snapshot", 0)),
+        "rows": int(manifest["total"]),
+        "shards": len(manifest["shards"]),
+        "shard_rows": int(manifest["shard_rows"]),
+        "embed_dim": int(manifest["embed_dim"]),
+        "normalized": bool(manifest.get("normalized", False)),
+        "wal_through": int(manifest.get("wal_through", 0)),
+    }}
+    try:
+        from dcr_tpu.search.livestore import load_wal_tail
+
+        feats, _keys, wal = load_wal_tail(store_dir)
+        report["live"] = {"tail_rows": int(feats.shape[0]),
+                          "records": int(wal.get("records", 0)),
+                          "torn_segments": int(wal.get("torn_segments", 0))}
+    except Exception:
+        report["live"] = {"tail_rows": 0, "records": 0, "torn_segments": 0}
+    report["ann"] = ann.ann_stats(store_dir)
+    return report
+
+
+def _cmd_stats(cfg: SearchConfig) -> None:
+    if not cfg.store_dir:
+        raise SystemExit("stats needs --store_dir=<dir>")
+    report = store_stats(cfg.store_dir)
+    if cfg.json_out:
+        print(json.dumps(report, indent=1, sort_keys=True))
+        return
+    c = report["committed"]
+    print(f"store      {report['store_dir']}")
+    print(f"committed  {c['rows']} rows in {c['shards']} shard(s) "
+          f"(snapshot v{c['snapshot']}, shard_rows={c['shard_rows']}, "
+          f"dim={c['embed_dim']}, "
+          f"{'normalized' if c['normalized'] else 'raw'}, "
+          f"wal_through={c['wal_through']})")
+    lv = report["live"]
+    print(f"live       {lv['tail_rows']} uncompacted WAL row(s) in "
+          f"{lv['records']} record(s), {lv['torn_segments']} torn")
+    a = report["ann"]
+    if a is None:
+        print("ann        (none — run `dcr-search train-ivf`)")
+    else:
+        print(f"ann        {a['rows']} rows in {a['nonempty_lists']}/"
+              f"{a['n_lists']} lists (snapshot v{a['snapshot']}, "
+              f"{a['quantization']}, "
+              f"{'normalized' if a['normalized'] else 'raw'}, "
+              f"max list {a['max_list_rows']} rows, seed={a['seed']})")
+
+
 def main(argv=None) -> None:
     from dcr_tpu.cli import setup_platform
 
@@ -137,6 +215,10 @@ def main(argv=None) -> None:
         _cmd_recover(cfg, compact=False)
     elif command == "compact":
         _cmd_recover(cfg, compact=True)
+    elif command == "train-ivf":
+        _cmd_train_ivf(cfg)
+    elif command == "stats":
+        _cmd_stats(cfg)
     else:
         raise SystemExit(f"unknown subcommand {command!r}")
 

@@ -336,6 +336,13 @@ class RiskConfig:
     # one device-resident matmul operand.
     store_dir: str = ""
     segment_rows: int = 0     # rows per device segment for store mode; 0=auto
+    # dcr-ann: score through the store's IVF + int8 approximate tier with
+    # exact f32 re-ranking (requires a trained index — `dcr-search
+    # train-ivf --search.ivf_normalize`). The exact engine stays the
+    # default: risk scores feed a threshold, and the ann tier trades
+    # bounded recall for sublinear corpus cost only when asked.
+    ann: bool = False
+    nprobe: int = 8           # probed lists per query in ann mode
     # SSCD backbone weights (torch state dict / TorchScript archive,
     # converted on load). "" = deterministic random init — self-consistent
     # (an index embedded with the same init scores correctly) but NOT
@@ -633,6 +640,12 @@ def validate_risk_config(r: RiskConfig) -> None:
         raise ValueError("risk.threshold must be a number, not NaN")
     if r.max_evidence < 0:
         raise ValueError("risk.max_evidence must be >= 0")
+    if r.ann and not r.store_dir:
+        raise ValueError("risk.ann needs risk.store_dir (the IVF tier is "
+                         "an index over a built store — the dump-file path "
+                         "is exact-only)")
+    if r.nprobe < 1:
+        raise ValueError("risk.nprobe must be >= 1")
 
 
 def validate_pipe_config(cfg: "TrainConfig") -> None:
@@ -749,6 +762,16 @@ class SearchConfig:
     # dcr-live: query the committed snapshot PLUS the WAL live tail (rows
     # acked by a streaming ingester but not yet compacted), merged
     live: bool = False
+    # -- dcr-ann: IVF + int8 approximate tier (search/ann.py) ---------------
+    ann: bool = False            # query via the ann tier (exact = default)
+    n_lists: int = 64            # IVF coarse centroids (train-ivf)
+    nprobe: int = 8              # probed lists per query (recall knob)
+    ivf_iters: int = 10          # Lloyd iterations (train-ivf)
+    ivf_seed: int = 0            # k-means init seed (determinism pin)
+    ivf_train_rows: int = 0      # training subsample; 0 = whole store
+    ivf_normalize: bool = False  # L2-normalize rows before train (cosine)
+    shortlist_k: int = 32        # int8 shortlist per (query, segment)
+    json_out: bool = False       # machine-readable `stats` output
     warm_dir: str = ""           # persistent executable cache (dcr-warm)
     logdir: str = ""             # trace.jsonl sink for search/* spans
 

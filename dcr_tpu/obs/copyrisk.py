@@ -399,7 +399,22 @@ class CopyRiskIndex:
             # the whole backbone on every scored batch
             sscd_params = jax.device_put(extractor.args[0])
             self._extract = lambda imgs: embed(sscd_params, imgs)
-            if self._store is not None:
+            if self._store is not None and self.cfg.ann:
+                # dcr-ann scoring: IVF + int8 approximate tier with exact
+                # f32 re-ranking. Opt-in (--risk.ann): the candidate set is
+                # approximate, so the exact engine stays the default. The
+                # index must carry cosine-convention (normalized) rows —
+                # the engine refuses otherwise rather than mis-rank.
+                from dcr_tpu.search.annindex import AnnEngine
+
+                self._engine = AnnEngine(
+                    self._store.dir, mesh=mesh, top_k=self.top_k,
+                    nprobe=self.cfg.nprobe, query_batch=self.batch,
+                    segment_rows=self.cfg.segment_rows,
+                    normalize_queries=True, require_normalized_rows=True,
+                    warm_dir=self.warm_dir).build()
+                scorer_src = "ann"
+            elif self._store is not None:
                 # store-backed scoring: the mesh-sharded search/topk engine
                 # (cosine: queries normalized in-program, index rows
                 # normalized host-side at segment load unless the store was
@@ -461,13 +476,27 @@ class CopyRiskIndex:
                         and reader.total == self._store.total):
                     return False
                 try:
-                    engine = ShardedTopK(
-                        reader, mesh=self._mesh, top_k=self.top_k,
-                        query_batch=self.batch,
-                        segment_rows=old.segment_rows,
-                        normalize_queries=True,
-                        normalize_rows=not reader.normalized,
-                        warm_dir=self.warm_dir).build()
+                    if self.cfg.ann:
+                        from dcr_tpu.search.annindex import AnnEngine
+
+                        # same geometry as the running engine, so the warm
+                        # ivf_scan/topk programs are reused, zero compiles
+                        engine = AnnEngine(
+                            reader.dir, mesh=self._mesh, top_k=self.top_k,
+                            nprobe=self.cfg.nprobe,
+                            query_batch=self.batch,
+                            segment_rows=old.segment_rows,
+                            normalize_queries=True,
+                            require_normalized_rows=True,
+                            warm_dir=self.warm_dir).build()
+                    else:
+                        engine = ShardedTopK(
+                            reader, mesh=self._mesh, top_k=self.top_k,
+                            query_batch=self.batch,
+                            segment_rows=old.segment_rows,
+                            normalize_queries=True,
+                            normalize_rows=not reader.normalized,
+                            warm_dir=self.warm_dir).build()
                     break
                 except StoreSnapshotChangedError as e:
                     if attempt:

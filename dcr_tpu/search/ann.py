@@ -1,0 +1,799 @@
+"""dcr-ann: IVF coarse quantizer + int8 inverted lists over the store.
+
+The exact engine (:mod:`dcr_tpu.search.shardindex`) scans every committed
+row per query — the right oracle, but linear in corpus size and full f32
+per resident row. This module is the training/storage half of ROADMAP
+item 2: a k-means coarse quantizer (IVF) trained ON DEVICE over the
+committed store via the ``search/kmeans`` compile surface, with each
+centroid's rows materialized as an int8-coded *inverted list* the scan
+half (:mod:`dcr_tpu.search.annindex`) probes selectively.
+
+Training is Lloyd's algorithm, one jitted step per corpus segment:
+assignment is ``argmax(feats @ C.T - 0.5*||C||^2)`` (exact L2 nearest
+centroid, first-index tie-break) and the per-centroid sums/counts
+accumulate through a one-hot matmul — a fixed-shape MXU reduction, never
+a scatter — so the same seed and the same shards produce BIT-IDENTICAL
+centroids on every run. List membership always comes from the single
+host-side :func:`assign_rows` (training, folds, and rebuilds agree by
+construction). A non-finite centroid update (the ``kmeans_nan@iter=N``
+fault kind drives this deterministically) restarts training with a
+shifted seed, counted and bounded — never committed.
+
+Storage mirrors the store discipline exactly (same verify-before-load,
+same quarantine, same commit ordering), under ``<store_dir>/ann/``::
+
+    ann/ann_manifest.v<N>.json   # per-list sha256 + scale/zero-point
+    ann/CURRENT                  # atomic pointer — the commit point
+    ann/writer.lease.json        # single-writer heartbeat lease
+    ann/centroids_v<N>.npz       # f32 [n_lists, D]
+    ann/list_00007_v<N>.npz      # codes int8 [n,D], feats f32, keys, ...
+
+- every list/centroid blob is sha256-verified from bytes BEFORE
+  ``np.load``; a damaged list is quarantine-renamed, counted as
+  ``ann/ivf_list_corrupt``, and **rebuilt** from the committed store (the
+  store is the source of truth — a list is a projection of it). The
+  ``ivf_list_corrupt@load=N`` fault kind poisons the Nth list read in
+  memory so CI drives verify→quarantine→rebuild end to end;
+- the manifest commits LAST and ``CURRENT`` flips atomically
+  (:func:`fsio.publish_durable`, same as livestore) — a killed train/fold
+  leaves the previous snapshot serving;
+- **incremental folds**: :func:`fold_rows` assigns new rows (the live
+  tier's compacted WAL rows) to their lists and rewrites ONLY the
+  affected lists under a new snapshot; untouched lists keep their exact
+  file + sha256 manifest entries, which is how tests pin "append moves
+  only affected lists".
+
+Codes are per-list affine int8: ``zero = (hi+lo)/2``, ``scale =
+max((hi-lo)/254, 1e-12)``, symmetric range [-127, 127] — so HBM per
+resident row drops ~4x while the f32 rows ride host-side for the exact
+re-rank of the shortlist. Stale per-snapshot files from superseded
+snapshots are left on disk (GC is future work, same as store manifests).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import time
+from io import BytesIO
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import fsio
+from dcr_tpu.core import resilience as R
+from dcr_tpu.core import tracing
+from dcr_tpu.core.compile_surface import compile_surface
+from dcr_tpu.core.warmcache import quarantine_rename
+from dcr_tpu.search.store import (EmbeddingStoreReader, StoreError,
+                                  StoreWriterLease, normalize_rows)
+
+log = logging.getLogger("dcr_tpu")
+
+ANN_VERSION = 1
+ANN_KIND = "dcr_ann_index"
+#: the ann tier lives in this subdirectory of the store it indexes
+ANN_DIRNAME = "ann"
+CURRENT_NAME = "CURRENT"
+#: default number of coarse centroids (inverted lists)
+DEFAULT_N_LISTS = 64
+#: default Lloyd iterations
+DEFAULT_IVF_ITERS = 10
+#: bounded non-finite-centroid restarts (seed shifts by +1 each restart)
+MAX_KMEANS_RESTARTS = 3
+#: rows per compiled k-means segment (same ballpark as the topk engine)
+DEFAULT_TRAIN_SEGMENT_ROWS = 65536
+
+_ANN_VERSIONED_RE = re.compile(r"^ann_manifest\.v(\d+)\.json$")
+
+
+class AnnError(StoreError):
+    """Typed: the ann tier cannot serve (absent/corrupt manifest or
+    centroids, training failure, or a width mismatch with its store). The
+    exact path is always available as the fallback — callers decide
+    whether ann absence is fatal (explicit ``--ann``) or a degrade."""
+
+
+def ann_dir(store_dir: str | Path) -> Path:
+    return Path(store_dir) / ANN_DIRNAME
+
+
+def versioned_ann_manifest_name(snapshot: int) -> str:
+    return f"ann_manifest.v{int(snapshot)}.json"
+
+
+def _sha(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(data).hexdigest()
+
+
+def _read_current_pointer(adir: Path, *,
+                          quarantine: bool = True) -> Optional[str]:
+    """Resolve the ann ``CURRENT`` pointer, or None when no index exists.
+    A pointer naming anything but a versioned ann manifest is corruption
+    of the commit point: quarantined + counted + typed (store pattern)."""
+    cur = adir / CURRENT_NAME
+    try:
+        raw = cur.read_text()
+    except FileNotFoundError:
+        return None
+    except OSError as e:
+        raise AnnError(f"ann CURRENT pointer unreadable: {e!r}") from e
+    name = raw.strip()
+    if not _ANN_VERSIONED_RE.match(name):
+        dest = quarantine_rename(cur) if quarantine else None
+        R.log_event("ann_manifest_corrupt", error=f"CURRENT names {name!r}",
+                    path=str(cur),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter("ann/manifest_corrupt").inc()
+        raise AnnError(
+            f"ann manifest corrupt (CURRENT names {name!r}); quarantined — "
+            "re-run `dcr-search train-ivf`")
+    return name
+
+
+def has_ann_index(store_dir: str | Path) -> bool:
+    """True iff ``store_dir`` carries a committed ann tier (cheap: one
+    pointer read, no quarantine side effects)."""
+    try:
+        return _read_current_pointer(ann_dir(store_dir),
+                                     quarantine=False) is not None
+    except AnnError:
+        return False
+
+
+def ann_snapshot_version(store_dir: str | Path) -> int:
+    name = _read_current_pointer(ann_dir(store_dir), quarantine=False)
+    return int(_ANN_VERSIONED_RE.match(name).group(1)) if name else 0
+
+
+def read_ann_manifest(store_dir: str | Path, *,
+                      quarantine: bool = True) -> dict:
+    """Load + structurally verify the committed ann manifest. Raises
+    :class:`AnnError`; an unparseable manifest is quarantine-renamed
+    (unless ``quarantine=False`` — read-only inspection)."""
+    adir = ann_dir(store_dir)
+    current = _read_current_pointer(adir, quarantine=quarantine)
+    if current is None:
+        raise AnnError(
+            f"{store_dir} has no ann index — run `dcr-search train-ivf` "
+            "first (exact search works without one)")
+    path = adir / current
+    try:
+        raw = R.read_bytes_with_retry(path, name="ann_manifest")
+    except FileNotFoundError:
+        raise AnnError(
+            f"ann manifest corrupt: {CURRENT_NAME} names {current} but the "
+            "file is missing — re-run `dcr-search train-ivf`") from None
+    except OSError as e:
+        raise AnnError(f"ann manifest unreadable: {e!r}") from e
+    try:
+        doc = json.loads(raw.decode("utf-8"))
+        if doc.get("kind") != ANN_KIND:
+            raise ValueError(f"kind is {doc.get('kind')!r}, not {ANN_KIND}")
+        for field in ("embed_dim", "n_lists", "total"):
+            if not isinstance(doc.get(field), int):
+                raise ValueError(f"manifest field {field!r} missing/not int")
+        if not isinstance(doc.get("lists"), list):
+            raise ValueError("manifest missing lists")
+        if not isinstance(doc.get("centroids"), dict):
+            raise ValueError("manifest missing centroids entry")
+    except (UnicodeDecodeError, ValueError) as e:
+        dest = quarantine_rename(path) if quarantine else None
+        R.log_event("ann_manifest_corrupt", error=repr(e), path=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter("ann/manifest_corrupt").inc()
+        raise AnnError(
+            f"ann manifest corrupt ({e}); quarantined — re-run "
+            "`dcr-search train-ivf`") from e
+    doc["snapshot"] = int(_ANN_VERSIONED_RE.match(current).group(1))
+    return doc
+
+
+# ---------------------------------------------------------------------------
+# The on-device Lloyd iteration (compile surface)
+# ---------------------------------------------------------------------------
+
+@compile_surface("search/kmeans")
+def make_kmeans_step(n_lists: int):
+    """Jitted ``(feats [R, D], valid [R], centroids [L, D]) ->
+    (sums [L, D], counts [L])`` — one Lloyd accumulation over one corpus
+    segment.
+
+    Assignment is exact L2 nearest-centroid via the expanded form
+    ``argmax(feats @ C.T - 0.5*||C||^2)`` (the ``||feats||^2`` term is
+    constant per row and drops out of the argmax); ``argmax`` breaks ties
+    on the first index, so assignment is deterministic. The per-centroid
+    reduction is a one-hot matmul — fixed-shape, MXU-shaped, and
+    bit-deterministic across runs, unlike a scatter-add — with pad rows
+    (``valid`` False) contributing to no centroid."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(feats, valid, centroids):
+        scores = (feats @ centroids.T
+                  - 0.5 * jnp.sum(centroids * centroids, axis=-1)[None, :])
+        assign = jnp.argmax(scores, axis=-1)
+        member = ((assign[:, None] == jnp.arange(n_lists)[None, :])
+                  & valid[:, None]).astype(jnp.float32)
+        sums = member.T @ feats
+        counts = jnp.sum(member, axis=0)
+        return sums, counts
+
+    return jax.jit(step)
+
+
+def assign_rows(feats: np.ndarray, centroids: np.ndarray) -> np.ndarray:
+    """Host-side nearest-centroid assignment — the ONE function every
+    materialization path (training, folds, rebuilds) routes membership
+    through, so a row can never land in different lists depending on
+    which path touched it. Same formula + first-index tie-break as the
+    device program."""
+    feats = np.asarray(feats, np.float32)
+    centroids = np.asarray(centroids, np.float32)
+    scores = (feats @ centroids.T
+              - 0.5 * np.sum(centroids * centroids, axis=-1)[None, :])
+    return np.argmax(scores, axis=1)
+
+
+def quantize_list(feats: np.ndarray) -> tuple[np.ndarray, float, float]:
+    """Per-list affine int8: ``(codes, scale, zero)`` with
+    ``feats ~= codes * scale + zero`` (symmetric code range [-127, 127];
+    -128 unused so negation can't overflow). An empty list quantizes to
+    identity parameters."""
+    feats = np.asarray(feats, np.float32)
+    if feats.size == 0:
+        return np.zeros(feats.shape, np.int8), 1.0, 0.0
+    lo = float(feats.min())
+    hi = float(feats.max())
+    zero = (hi + lo) / 2.0
+    scale = max((hi - lo) / 254.0, 1e-12)
+    codes = np.clip(np.rint((feats - zero) / scale), -127, 127)
+    return codes.astype(np.int8), scale, zero
+
+
+def dequantize(codes: np.ndarray, scale: float, zero: float) -> np.ndarray:
+    return codes.astype(np.float32) * np.float32(scale) + np.float32(zero)
+
+
+# ---------------------------------------------------------------------------
+# Reader: verify before load, quarantine on damage, rebuild from store
+# ---------------------------------------------------------------------------
+
+class AnnIndexReader:
+    """Verify-before-load access to a committed ann index.
+
+    Construction reads only the manifest; centroids and lists stream on
+    demand. A list that fails verification is quarantine-renamed, counted
+    (``ann/ivf_list_corrupt``), and reported in :attr:`failed_lists` so
+    the engine can rebuild it from the committed store — the degrade is
+    *recoverable*, unlike a lost store shard. ``quarantine=False`` makes
+    verification read-only (``dcr-search stats``/``verify`` on a shared
+    store must not rename anything).
+    """
+
+    def __init__(self, store_dir: str | Path, *, quarantine: bool = True):
+        self.store_dir = Path(store_dir)
+        self.dir = ann_dir(store_dir)
+        self.quarantine = bool(quarantine)
+        self.manifest = read_ann_manifest(store_dir,
+                                          quarantine=self.quarantine)
+        self.embed_dim = int(self.manifest["embed_dim"])
+        self.n_lists = int(self.manifest["n_lists"])
+        self.normalized = bool(self.manifest.get("normalized", False))
+        self.total = int(self.manifest["total"])
+        self.snapshot = int(self.manifest["snapshot"])
+        self.store_snapshot = int(self.manifest.get("store_snapshot", 0))
+        #: list ids that failed verification during this reader's life
+        self.failed_lists: list[int] = []
+        self._load_seq = 0
+
+    @property
+    def lists(self) -> list[dict]:
+        return list(self.manifest["lists"])
+
+    def load_centroids(self) -> np.ndarray:
+        """Verified centroids [n_lists, D]. Centroids are the index's one
+        unrecoverable-by-rebuild artifact (lists are projections of the
+        store; centroids are the projection RULE), so damage is typed —
+        the remedy is retraining, and the exact path keeps serving."""
+        entry = self.manifest["centroids"]
+        path = self.dir / str(entry.get("file", ""))
+        try:
+            blob = R.read_bytes_with_retry(path, name="ann_centroids")
+        except (FileNotFoundError, OSError) as e:
+            raise AnnError(f"ann centroids unreadable: {e!r} — re-run "
+                           "`dcr-search train-ivf`") from e
+        if _sha(blob) != entry.get("sha256"):
+            dest = quarantine_rename(path) if self.quarantine else None
+            R.log_event("ann_centroids_corrupt", path=str(path),
+                        quarantined_to=str(dest) if dest else None)
+            tracing.registry().counter("ann/centroids_corrupt").inc()
+            raise AnnError("ann centroids corrupt (sha256 mismatch); "
+                           "quarantined — re-run `dcr-search train-ivf`")
+        with np.load(BytesIO(blob), allow_pickle=False) as z:
+            centroids = np.asarray(z["centroids"], np.float32)
+        if centroids.shape != (self.n_lists, self.embed_dim) \
+                or not np.isfinite(centroids).all():
+            raise AnnError(
+                f"ann centroids invalid (shape {centroids.shape}, expected "
+                f"({self.n_lists}, {self.embed_dim})) — re-run "
+                "`dcr-search train-ivf`")
+        return centroids
+
+    def load_list(self, entry: dict) -> Optional[
+            tuple[np.ndarray, np.ndarray, np.ndarray, float, float]]:
+        """Verified ``(codes int8 [n,D], feats f32 [n,D], keys [n],
+        scale, zero)`` for one manifest list entry, or None after
+        quarantine on damage (the caller rebuilds from the store)."""
+        from dcr_tpu.utils import faults
+
+        list_id = int(entry.get("list", -1))
+        if int(entry.get("count", 0)) == 0 and not entry.get("file"):
+            empty = np.zeros((0, self.embed_dim), np.float32)
+            return (np.zeros((0, self.embed_dim), np.int8), empty,
+                    np.zeros((0,), dtype=object), 1.0, 0.0)
+        path = self.dir / str(entry.get("file", ""))
+        try:
+            blob = R.read_bytes_with_retry(path, name="ann_list")
+        except (FileNotFoundError, OSError) as e:
+            self._quarantine(list_id, path, repr(e), rename=False)
+            return None
+        seq = self._load_seq
+        self._load_seq += 1
+        if faults.fire("ivf_list_corrupt", load=seq):
+            # deterministic CI poisoning: damage the blob in memory so the
+            # REAL verify/quarantine/rebuild path runs end to end
+            mid = len(blob) // 2
+            blob = blob[:mid] + bytes([blob[mid] ^ 0xFF]) + blob[mid + 1:] \
+                if blob else b""
+        if _sha(blob) != entry.get("sha256"):
+            self._quarantine(list_id, path, "sha256 mismatch")
+            return None
+        try:
+            with np.load(BytesIO(blob), allow_pickle=False) as z:
+                codes = np.asarray(z["codes"], np.int8)
+                feats = np.asarray(z["features"], np.float32)
+                keys = np.asarray(z["keys"], dtype=str).astype(object)
+                scale = float(z["scale"])
+                zero = float(z["zero"])
+        except Exception as e:
+            self._quarantine(list_id, path, f"unreadable npz: {e!r}")
+            return None
+        n = codes.shape[0] if codes.ndim == 2 else -1
+        if not (codes.ndim == 2 and codes.shape[1] == self.embed_dim
+                and feats.shape == codes.shape and len(keys) == n
+                and n == entry.get("count")):
+            self._quarantine(list_id, path,
+                             f"shape/count mismatch: codes {codes.shape}, "
+                             f"features {feats.shape}, {len(keys)} keys, "
+                             f"manifest count {entry.get('count')}")
+            return None
+        if not (np.isfinite(feats).all() and np.isfinite(scale)
+                and np.isfinite(zero) and scale > 0):
+            self._quarantine(list_id, path, "non-finite payload")
+            return None
+        return codes, feats, keys, scale, zero
+
+    def _quarantine(self, list_id: int, path: Path, detail: str,
+                    rename: bool = True) -> None:
+        dest = quarantine_rename(path) if rename and self.quarantine else None
+        if list_id >= 0 and list_id not in self.failed_lists:
+            self.failed_lists.append(list_id)
+        R.log_event("ann_list_quarantined", list=list_id, detail=detail,
+                    path=str(path),
+                    quarantined_to=str(dest) if dest else None)
+        tracing.registry().counter("ann/ivf_list_corrupt").inc()
+
+    def verify(self) -> dict:
+        """Walk every list through the full verification path; returns
+        ``{lists, ok, corrupt, rows_ok, total}`` (``dcr-search stats``)."""
+        ok = corrupt = rows = 0
+        for entry in self.manifest["lists"]:
+            loaded = self.load_list(entry)
+            if loaded is None:
+                corrupt += 1
+            else:
+                ok += 1
+                rows += loaded[0].shape[0]
+        return {"lists": len(self.manifest["lists"]), "ok": ok,
+                "corrupt": corrupt, "rows_ok": rows, "total": self.total}
+
+
+# ---------------------------------------------------------------------------
+# Training + materialization
+# ---------------------------------------------------------------------------
+
+def _pad_segments(feats: np.ndarray, segment_rows: int
+                  ) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Split rows into fixed ``(feats [S, D], valid [S])`` device segments
+    (zero-padded) so every Lloyd step hits one compiled shape."""
+    segs = []
+    for start in range(0, feats.shape[0], segment_rows):
+        chunk = feats[start:start + segment_rows]
+        n = chunk.shape[0]
+        valid = np.zeros((segment_rows,), bool)
+        valid[:n] = True
+        if n < segment_rows:
+            chunk = np.concatenate(
+                [chunk, np.zeros((segment_rows - n, chunk.shape[1]),
+                                 np.float32)])
+        segs.append((chunk, valid))
+    return segs
+
+
+def _publish_blob(adir: Path, name: str, blob: bytes) -> dict:
+    path = adir / name
+    tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
+    fsio.publish_durable(tmp, path, blob)
+    return {"file": name, "sha256": _sha(blob)}
+
+
+def _list_blob(codes: np.ndarray, feats: np.ndarray, keys: np.ndarray,
+               scale: float, zero: float) -> bytes:
+    buf = BytesIO()
+    np.savez(buf, codes=codes, features=feats,
+             keys=np.asarray([str(k) for k in keys], dtype=str),
+             scale=np.float32(scale), zero=np.float32(zero))
+    return buf.getvalue()
+
+
+def _commit_manifest(adir: Path, doc: dict, snapshot: int) -> Path:
+    """Manifest first (dir-fsynced), then the atomic ``CURRENT`` flip —
+    the flip IS the commit point, exactly the store/livestore ordering."""
+    name = versioned_ann_manifest_name(snapshot)
+    path = adir / name
+    tmp = path.with_name(f"{name}.tmp.{os.getpid()}")
+    fsio.publish_durable(tmp, path,
+                         json.dumps(doc, indent=1, sort_keys=True) + "\n",
+                         sync_dir=True)
+    cur = adir / CURRENT_NAME
+    ctmp = cur.with_name(f"{CURRENT_NAME}.tmp.{os.getpid()}")
+    fsio.publish_durable(ctmp, cur, name + "\n", sync_dir=True)
+    return path
+
+
+def _materialize_lists(adir: Path, snapshot: int, n_lists: int,
+                       assign: np.ndarray, feats: np.ndarray,
+                       keys: np.ndarray) -> tuple[list[dict], int]:
+    """Quantize + publish every list for a full (re)build; returns the
+    manifest ``lists`` entries and the row total."""
+    entries: list[dict] = []
+    total = 0
+    for list_id in range(n_lists):
+        mask = assign == list_id
+        entries.append(_publish_list(adir, snapshot, list_id, feats[mask],
+                                     keys[mask]))
+        total += int(entries[-1]["count"])
+    return entries, total
+
+
+def _publish_list(adir: Path, snapshot: int, list_id: int,
+                  feats: np.ndarray, keys: np.ndarray) -> dict:
+    """Quantize + durably publish one inverted list; returns its manifest
+    entry. Empty lists get a fileless entry (nothing to verify or scan)."""
+    n = int(feats.shape[0])
+    if n == 0:
+        return {"list": list_id, "file": "", "sha256": "", "count": 0,
+                "scale": 1.0, "zero": 0.0}
+    codes, scale, zero = quantize_list(feats)
+    name = f"list_{list_id:05d}_v{snapshot}.npz"
+    entry = _publish_blob(adir, name,
+                          _list_blob(codes, feats, keys, scale, zero))
+    entry.update(list=list_id, count=n, scale=scale, zero=zero)
+    return entry
+
+
+def train_ivf(store_dir: str | Path, *, n_lists: int = DEFAULT_N_LISTS,
+              iters: int = DEFAULT_IVF_ITERS, seed: int = 0,
+              train_rows: int = 0, segment_rows: int = 0,
+              normalize: bool = False, warm_dir: str = "") -> dict:
+    """Train the IVF quantizer over the committed store and materialize
+    the inverted lists as a new ann snapshot.
+
+    ``train_rows > 0`` subsamples the corpus for the Lloyd loop
+    (deterministically, from ``seed``) — materialization always covers
+    every committed row. ``normalize=True`` L2-normalizes rows before
+    training AND materialization (recorded in the manifest; required for
+    cosine-convention consumers like copy-risk when the store itself was
+    not built normalized). Returns a report dict (the CLI prints it).
+    """
+    if int(n_lists) < 1:
+        raise AnnError(f"n_lists must be >= 1, got {n_lists}")
+    if int(iters) < 1:
+        raise AnnError(f"iters must be >= 1, got {iters}")
+    reader = EmbeddingStoreReader(store_dir)
+    feats, key_list = reader.load_all()
+    keys = np.asarray(key_list, dtype=object)
+    total = feats.shape[0]
+    if total < n_lists:
+        raise AnnError(
+            f"store has {total} rows < n_lists={n_lists} — lower "
+            "--search.n_lists or grow the store (IVF needs at least one "
+            "row per centroid)")
+    effective_norm = bool(normalize) and not reader.normalized
+    if effective_norm:
+        feats = normalize_rows(feats)
+    normalized = bool(normalize) or reader.normalized
+    dim = reader.embed_dim
+
+    if train_rows and 0 < train_rows < total:
+        pick = np.sort(np.random.default_rng(seed).choice(
+            total, int(train_rows), replace=False))
+        train_feats = feats[pick]
+    else:
+        train_feats = feats
+    seg_rows = int(segment_rows) if segment_rows > 0 else min(
+        max(train_feats.shape[0], 1), DEFAULT_TRAIN_SEGMENT_ROWS)
+    segments = _pad_segments(train_feats, seg_rows)
+
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core import warmcache
+
+    jit_fn = make_kmeans_step(n_lists)
+    feats_aval = jax.ShapeDtypeStruct((seg_rows, dim), jnp.float32)
+    valid_aval = jax.ShapeDtypeStruct((seg_rows,), jnp.bool_)
+    cent_aval = jax.ShapeDtypeStruct((n_lists, dim), jnp.float32)
+    cache = warmcache.WarmCache(warm_dir) if warm_dir else None
+    res = warmcache.aot_compile(
+        "search/kmeans", jit_fn, (feats_aval, valid_aval, cent_aval),
+        static_config={"n_lists": n_lists, "segment_rows": seg_rows,
+                       "embed_dim": dim}, cache=cache)
+    fn = warmcache.guarded(res.fn, jit_fn, "search/kmeans")
+
+    from dcr_tpu.utils import faults
+
+    centroids = None
+    restarts = 0
+    t0 = time.monotonic()
+    for restart in range(MAX_KMEANS_RESTARTS + 1):
+        rng = np.random.default_rng(seed + restart)
+        pick = np.sort(rng.choice(train_feats.shape[0], n_lists,
+                                  replace=False))
+        cand = np.ascontiguousarray(train_feats[pick], np.float32)
+        finite = True
+        for it in range(max(1, int(iters))):
+            sums = np.zeros((n_lists, dim), np.float64)
+            counts = np.zeros((n_lists,), np.float64)
+            with tracing.span("search/kmeans", iter=it, restart=restart,
+                              n_lists=n_lists,
+                              rows=int(train_feats.shape[0]),
+                              segments=len(segments)):
+                for seg_feats, seg_valid in segments:
+                    s, c = fn(seg_feats, seg_valid, cand)
+                    sums += np.asarray(s, np.float64)
+                    counts += np.asarray(c, np.float64)
+            # empty centroids keep their previous position (deterministic;
+            # no resampling mid-run)
+            nxt = np.where(counts[:, None] > 0,
+                           (sums / np.maximum(counts, 1.0)[:, None]),
+                           cand.astype(np.float64)).astype(np.float32)
+            if faults.fire("kmeans_nan", iter=it):
+                # deterministic CI poisoning: a non-finite update (the
+                # shape a device numerics bug or corrupt input takes)
+                nxt = nxt.copy()
+                nxt[0, 0] = np.nan
+            if not np.isfinite(nxt).all():
+                finite = False
+                restarts += 1
+                tracing.registry().counter("ann/kmeans_restart").inc()
+                R.log_event("ann_kmeans_restart", iter=it, restart=restart,
+                            seed=seed + restart)
+                log.warning("train_ivf: non-finite centroids at iter %d "
+                            "(restart %d) — restarting with seed %d",
+                            it, restart, seed + restart + 1)
+                break
+            cand = nxt
+        if finite:
+            centroids = cand
+            break
+    if centroids is None:
+        raise AnnError(
+            f"k-means produced non-finite centroids through "
+            f"{MAX_KMEANS_RESTARTS + 1} seeded restarts — inspect the "
+            "store for pathological rows (`dcr-search verify`)")
+
+    assign = assign_rows(feats, centroids)
+    adir = ann_dir(store_dir)
+    adir.mkdir(parents=True, exist_ok=True)
+    with StoreWriterLease(adir, owner="train-ivf").acquire():
+        snapshot = ann_snapshot_version(store_dir) + 1
+        buf = BytesIO()
+        np.savez(buf, centroids=centroids)
+        cent_entry = _publish_blob(adir, f"centroids_v{snapshot}.npz",
+                                   buf.getvalue())
+        entries, list_total = _materialize_lists(adir, snapshot, n_lists,
+                                                 assign, feats, keys)
+        doc = {
+            "version": ANN_VERSION,
+            "kind": ANN_KIND,
+            "created_at": time.time(),
+            "embed_dim": dim,
+            "n_lists": int(n_lists),
+            "normalized": normalized,
+            "seed": int(seed),
+            "iters": int(iters),
+            "train_rows": int(train_feats.shape[0]),
+            "restarts": restarts,
+            "total": list_total,
+            "store_snapshot": reader.snapshot,
+            "store_wal_through": reader.wal_through,
+            "centroids": cent_entry,
+            "lists": entries,
+        }
+        _commit_manifest(adir, doc, snapshot)
+    nonempty = sum(1 for e in entries if e["count"])
+    reg = tracing.registry()
+    reg.gauge("ann/lists").set(n_lists)
+    reg.gauge("ann/index_rows").set(list_total)
+    tracing.event("ann/trained", n_lists=n_lists, rows=list_total,
+                  iters=int(iters), restarts=restarts, snapshot=snapshot,
+                  seconds=round(time.monotonic() - t0, 3))
+    log.info("train_ivf: committed ann snapshot v%d — %d rows in %d/%d "
+             "nonempty lists (%d iters, %d restart(s), program %s)",
+             snapshot, list_total, nonempty, n_lists, iters, restarts,
+             res.source)
+    return {"snapshot": snapshot, "n_lists": int(n_lists),
+            "rows": list_total, "nonempty_lists": nonempty,
+            "iters": int(iters), "restarts": restarts,
+            "normalized": normalized, "seconds":
+                round(time.monotonic() - t0, 3)}
+
+
+# ---------------------------------------------------------------------------
+# Incremental folds + list rebuild (store is the source of truth)
+# ---------------------------------------------------------------------------
+
+def fold_rows(store_dir: str | Path, feats: np.ndarray,
+              keys: Sequence[str]) -> dict:
+    """Fold new rows (the live tier's just-compacted WAL rows) into their
+    inverted lists incrementally: assign against the committed centroids,
+    rewrite ONLY the affected lists under a new snapshot, and keep every
+    untouched list's manifest entry (file + sha) byte-identical. A list
+    that fails verification on the way in is rebuilt from the committed
+    store first — the fold never silently drops pre-existing rows."""
+    feats = np.asarray(feats, np.float32)
+    keys_arr = np.asarray([str(k) for k in keys], dtype=object)
+    if feats.ndim != 2 or len(keys_arr) != feats.shape[0]:
+        raise AnnError(f"fold_rows: features {feats.shape} with "
+                       f"{len(keys_arr)} keys — torn input")
+    reader = AnnIndexReader(store_dir)
+    if feats.shape[0] and feats.shape[1] != reader.embed_dim:
+        raise AnnError(f"fold_rows: width {feats.shape[1]} != ann width "
+                       f"{reader.embed_dim}")
+    if feats.shape[0] == 0:
+        return {"rows": 0, "lists_rewritten": 0,
+                "snapshot": reader.snapshot}
+    centroids = reader.load_centroids()
+    if reader.normalized:
+        feats = normalize_rows(feats)
+    assign = assign_rows(feats, centroids)
+    affected = sorted(set(int(a) for a in assign))
+    adir = reader.dir
+    with StoreWriterLease(adir, owner="ann-fold").acquire():
+        snapshot = reader.snapshot + 1
+        by_id = {int(e["list"]): dict(e) for e in reader.manifest["lists"]}
+        rebuilt = 0
+        for list_id in affected:
+            entry = by_id.get(list_id)
+            if entry is None:
+                raise AnnError(f"ann manifest has no list {list_id} "
+                               f"(n_lists={reader.n_lists})")
+            loaded = reader.load_list(entry)
+            if loaded is None:
+                old_feats, old_keys = _derive_list_rows(
+                    store_dir, centroids, list_id,
+                    normalized=reader.normalized)
+                rebuilt += 1
+                tracing.registry().counter("ann/list_rebuilt").inc()
+            else:
+                _codes, old_feats, old_keys, _s, _z = loaded
+            mask = assign == list_id
+            new_feats = np.concatenate([old_feats, feats[mask]]) \
+                if old_feats.size else feats[mask]
+            new_keys = np.concatenate([old_keys, keys_arr[mask]]) \
+                if len(old_keys) else keys_arr[mask]
+            by_id[list_id] = _publish_list(adir, snapshot, list_id,
+                                           new_feats, new_keys)
+        entries = [by_id[i] for i in sorted(by_id)]
+        doc = dict(reader.manifest)
+        doc.pop("snapshot", None)
+        doc.update(created_at=time.time(),
+                   total=sum(int(e["count"]) for e in entries),
+                   lists=entries)
+        _commit_manifest(adir, doc, snapshot)
+    reg = tracing.registry()
+    reg.counter("ann/fold_rows_total").inc(int(feats.shape[0]))
+    reg.counter("ann/lists_folded_total").inc(len(affected))
+    reg.gauge("ann/index_rows").set(int(doc["total"]))
+    tracing.event("ann/folded", rows=int(feats.shape[0]),
+                  lists=len(affected), rebuilt=rebuilt, snapshot=snapshot)
+    return {"rows": int(feats.shape[0]), "lists_rewritten": len(affected),
+            "lists_rebuilt": rebuilt, "snapshot": snapshot}
+
+
+def _derive_list_rows(store_dir: str | Path, centroids: np.ndarray,
+                      list_id: int, *, normalized: bool
+                      ) -> tuple[np.ndarray, np.ndarray]:
+    """Re-derive one list's rows from the committed store (the rebuild
+    path: lists are projections of the store, so a quarantined list loses
+    nothing that can't be recomputed)."""
+    store = EmbeddingStoreReader(store_dir)
+    feats_parts: list[np.ndarray] = []
+    keys_parts: list[np.ndarray] = []
+    for feats, ks in store.iter_shards():
+        if normalized and not store.normalized:
+            feats = normalize_rows(feats)
+        mask = assign_rows(feats, centroids) == list_id
+        if mask.any():
+            feats_parts.append(feats[mask])
+            keys_parts.append(np.asarray(ks, dtype=object)[mask])
+    if not feats_parts:
+        return (np.zeros((0, store.embed_dim), np.float32),
+                np.zeros((0,), dtype=object))
+    return np.concatenate(feats_parts), np.concatenate(keys_parts)
+
+
+def rebuild_list(store_dir: str | Path, list_id: int) -> dict:
+    """Rebuild one quarantined/damaged inverted list from the committed
+    store and commit it under a new snapshot (verify→quarantine→rebuild,
+    the recovery the ``ivf_list_corrupt`` fault kind proves in CI).
+
+    NOTE: rows that only ever lived in folds of live WAL rows not yet
+    compacted into committed shards are re-derived at the store's current
+    snapshot — compaction folds WAL rows into the store BEFORE
+    :func:`fold_rows`, so the committed store is always a superset."""
+    reader = AnnIndexReader(store_dir)
+    if not 0 <= int(list_id) < reader.n_lists:
+        raise AnnError(f"list {list_id} out of range "
+                       f"(n_lists={reader.n_lists})")
+    centroids = reader.load_centroids()
+    feats, keys = _derive_list_rows(store_dir, centroids, int(list_id),
+                                    normalized=reader.normalized)
+    adir = reader.dir
+    with StoreWriterLease(adir, owner="ann-rebuild").acquire():
+        snapshot = reader.snapshot + 1
+        by_id = {int(e["list"]): dict(e) for e in reader.manifest["lists"]}
+        by_id[int(list_id)] = _publish_list(adir, snapshot, int(list_id),
+                                            feats, keys)
+        entries = [by_id[i] for i in sorted(by_id)]
+        doc = dict(reader.manifest)
+        doc.pop("snapshot", None)
+        doc.update(created_at=time.time(),
+                   total=sum(int(e["count"]) for e in entries),
+                   lists=entries)
+        _commit_manifest(adir, doc, snapshot)
+    tracing.registry().counter("ann/list_rebuilt").inc()
+    tracing.event("ann/list_rebuilt", list=int(list_id),
+                  rows=int(feats.shape[0]), snapshot=snapshot)
+    log.info("rebuild_list: list %d rebuilt from store (%d rows) — ann "
+             "snapshot v%d", list_id, feats.shape[0], snapshot)
+    return {"list": int(list_id), "rows": int(feats.shape[0]),
+            "snapshot": snapshot}
+
+
+def ann_stats(store_dir: str | Path) -> Optional[dict]:
+    """Read-only summary of the ann tier for ``dcr-search stats`` (None
+    when no index is committed; never quarantines)."""
+    if not has_ann_index(store_dir):
+        return None
+    reader = AnnIndexReader(store_dir, quarantine=False)
+    counts = [int(e["count"]) for e in reader.manifest["lists"]]
+    return {
+        "snapshot": reader.snapshot,
+        "store_snapshot": reader.store_snapshot,
+        "n_lists": reader.n_lists,
+        "nonempty_lists": sum(1 for c in counts if c),
+        "rows": reader.total,
+        "max_list_rows": max(counts) if counts else 0,
+        "normalized": reader.normalized,
+        "quantization": "int8-affine-per-list",
+        "seed": reader.manifest.get("seed"),
+        "iters": reader.manifest.get("iters"),
+    }

@@ -1,0 +1,632 @@
+"""dcr-ann: nprobe-bounded IVF scan engine with exact f32 re-ranking.
+
+The query half of ROADMAP item 2, layered on :mod:`dcr_tpu.search.ann`'s
+inverted lists. Where the exact engine (:mod:`~dcr_tpu.search.shardindex`)
+scans EVERY committed row per query, this engine:
+
+- resolves each query's ``nprobe`` nearest centroids on host (an
+  [B, n_lists] matmul — tiny), and scans only segments holding a probed
+  list: per-query cost is bounded by the probed lists' rows, sublinear in
+  corpus size;
+- packs owned lists into fixed padded segments of int8 codes — the
+  ``search/ivf_scan`` program computes approximate scores ALGEBRAICALLY
+  from the int8 operand (``(q @ codes.T) * scale + zero * sum(q)``), so
+  the HBM-resident corpus is ~4x smaller than f32 and never materialized
+  as f32 rows;
+- re-ranks the int8 shortlist in f32 through the EXISTING ``search/topk``
+  program (a second, small warm-cache variant — the exact path's own
+  variants and their manifest HLO digests are untouched), so reported
+  scores are exact dot products, bit-comparable with the exact engine's;
+- groups queries by their top probe before chunking (stable sort,
+  scattered back), so a chunk's probed-list union stays small and whole
+  segments skip — this, not the int8 matmul, is where the throughput
+  multiple comes from;
+- owns lists per host (``list_id % process_count == process_index``):
+  each host loads, verifies, and scans ONLY its lists, and the host-local
+  [B, K] tables merge over the KV control plane
+  (:func:`dcr_tpu.core.dist.kv_allgather` — pure gRPC, works on every
+  backend). One process degenerates to single-host replication: all
+  lists owned, no control-plane traffic.
+
+Shortlist semantics: re-ranking runs over the CHUNK's candidate union
+(one fixed-shape program call per chunk), so a query can only ever gain
+extra candidates from chunk-mates' probed lists — recall is bounded below
+by per-query IVF semantics and results are deterministic for a fixed
+query array. Multi-host callers present identical query arrays on every
+host (the SPMD convention every sharded engine in this repo follows).
+
+A list that fails verification at build is quarantined + counted by the
+reader and REBUILT from the committed store (``ann.rebuild_list``) —
+the ``ivf_list_corrupt`` fault kind drives this path in CI. Both
+programs resolve through :mod:`dcr_tpu.core.warmcache`, so a warm restart
+answers its first ANN query with ZERO XLA compiles.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import tracing
+from dcr_tpu.core import warmcache
+from dcr_tpu.core.compile_surface import compile_surface
+from dcr_tpu.core.config import MeshConfig
+from dcr_tpu.search import ann as annmod
+from dcr_tpu.search.ann import AnnError, AnnIndexReader
+from dcr_tpu.search.shardindex import make_topk, merge_topk
+from dcr_tpu.search.store import EmbeddingStoreReader, normalize_rows
+
+log = logging.getLogger("dcr_tpu")
+
+#: default probed lists per query
+DEFAULT_NPROBE = 8
+#: default int8 shortlist per (query, segment); the re-rank budget
+DEFAULT_SHORTLIST_K = 32
+#: rows per packed int8 segment (smaller than the exact engine's — the
+#: segment is the probe-skipping granule, so finer is better here)
+DEFAULT_SEGMENT_ROWS = 8192
+#: segments whose total rows fit under this stay device-resident
+DEFAULT_MAX_RESIDENT_ROWS = 1 << 22
+
+
+@compile_surface("search/ivf_scan")
+def make_ivf_scan(shortlist_k: int):
+    """Jitted ``(codes int8 [S, D], scale [S], zero [S], row_list int32
+    [S], valid [S], probed bool [B, L], q [B, D]) -> (scores [B, K'],
+    idx [B, K'])`` — approximate scores over one packed segment, top
+    ``shortlist_k`` per query.
+
+    Approximate dot products come out of the int8 operand algebraically:
+    ``feats ~= codes*scale + zero`` (per-list affine), so ``q @ feats.T ~=
+    (q @ codes.T)*scale + zero*sum(q)`` — the f32 corpus never exists on
+    device. Rows whose list isn't probed for a query (and pad rows) mask
+    to ``-inf`` before the on-device ``lax.top_k`` merge."""
+    import jax
+    import jax.numpy as jnp
+
+    def scan(codes, scale, zero, row_list, valid, probed, q):
+        approx = (q @ codes.T.astype(jnp.float32)) * scale[None, :] \
+            + zero[None, :] * jnp.sum(q, axis=-1, keepdims=True)
+        mask = jnp.take(probed, row_list, axis=1) & valid[None, :]
+        scores = jnp.where(mask, approx, -jnp.inf)
+        return jax.lax.top_k(scores, shortlist_k)
+
+    return jax.jit(scan)
+
+
+def _merge_shortlist(scores: np.ndarray, rows: np.ndarray,
+                     new_scores: np.ndarray, new_rows: np.ndarray,
+                     keep: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host merge of two per-query approximate shortlists ``(scores
+    [B, k], global row ids [B, k])``, keeping the best ``keep`` (stable —
+    same tie discipline as :func:`merge_topk`)."""
+    all_scores = np.concatenate([scores, new_scores], axis=1)
+    all_rows = np.concatenate([rows, new_rows], axis=1)
+    order = np.argsort(-all_scores, axis=1, kind="stable")[:, :keep]
+    return (np.take_along_axis(all_scores, order, axis=1),
+            np.take_along_axis(all_rows, order, axis=1))
+
+
+class AnnEngine:
+    """IVF + int8 approximate top-k with exact re-rank — the ``ann`` mode
+    counterpart of :class:`~dcr_tpu.search.shardindex.ShardedTopK`, with
+    the same query/table contract so serve and copy-risk swap between
+    them behind one flag.
+
+    ``query`` is thread-safe after :meth:`build`; ``build`` is eager and
+    idempotent. ``rebuild_corrupt=False`` degrades a damaged list to its
+    committed-store absence instead of rewriting (read-only callers).
+    """
+
+    def __init__(self, store_dir, *, mesh=None, top_k: int = 1,
+                 nprobe: int = DEFAULT_NPROBE, query_batch: int = 64,
+                 shortlist_k: int = DEFAULT_SHORTLIST_K,
+                 segment_rows: int = 0,
+                 max_resident_rows: int = DEFAULT_MAX_RESIDENT_ROWS,
+                 normalize_queries: bool = False,
+                 require_normalized_rows: bool = False,
+                 rebuild_corrupt: bool = True, warm_dir: str = ""):
+        import jax
+
+        from dcr_tpu.parallel import mesh as pmesh
+
+        self.store_dir = store_dir
+        self.reader = EmbeddingStoreReader(store_dir)
+        self.ann = AnnIndexReader(store_dir)
+        if self.ann.embed_dim != self.reader.embed_dim:
+            raise AnnError(
+                f"ann width {self.ann.embed_dim} != store width "
+                f"{self.reader.embed_dim} — retrain (`dcr-search "
+                "train-ivf`)")
+        if require_normalized_rows and not self.ann.normalized:
+            raise AnnError(
+                "this consumer needs cosine scores but the ann index was "
+                "trained over unnormalized rows — retrain with "
+                "`dcr-search train-ivf --search.ivf_normalize`")
+        self.mesh = mesh if mesh is not None else pmesh.make_mesh(
+            MeshConfig(data=1), devices=jax.devices()[:1])
+        self.top_k = max(1, int(top_k))
+        self.nprobe = max(1, min(int(nprobe), self.ann.n_lists))
+        self.query_batch = max(1, int(query_batch))
+        self.shortlist_k = max(int(shortlist_k), self.top_k)
+        self.normalize_queries = bool(normalize_queries)
+        self.rebuild_corrupt = bool(rebuild_corrupt)
+        self.warm_dir = warm_dir
+        self._row_shards = int(pmesh.data_parallel_size(self.mesh))
+        want = int(segment_rows) if segment_rows > 0 else \
+            DEFAULT_SEGMENT_ROWS
+        want = max(want, self.shortlist_k)
+        self.segment_rows = -(-want // self._row_shards) * self._row_shards
+        self.max_resident_rows = int(max_resident_rows)
+        # the f32 candidate pool per chunk: every query's full shortlist
+        self.rerank_rows = -(-(self.query_batch * self.shortlist_k)
+                             // self._row_shards) * self._row_shards
+        self._centroids: Optional[np.ndarray] = None
+        self._feats: Optional[np.ndarray] = None   # host f32 [N_owned, D]
+        self._keys: Optional[np.ndarray] = None
+        self._segments: list[tuple] = []           # host or device tuples
+        self._seg_lists: list[set[int]] = []
+        self.resident = False
+        self.owned_lists: list[int] = []
+        self.num_segments = 0
+        self._scan_fn = None
+        self._rerank_fn = None
+        self._row_sharding = None
+        self._q_sharding = None
+        self._built = False
+
+    @property
+    def total(self) -> int:
+        return self.ann.total
+
+    def __len__(self) -> int:
+        return self.ann.total
+
+    # -- construction --------------------------------------------------------
+
+    def _owned(self) -> list[int]:
+        from dcr_tpu.core import dist
+
+        count = max(1, dist.process_count())
+        rank = dist.process_index() if count > 1 else 0
+        return [i for i in range(self.ann.n_lists) if i % count == rank]
+
+    def _load_owned_lists(self) -> tuple[np.ndarray, ...]:
+        """Verified rows of every owned list, packed in list-id order.
+        Returns ``(codes [N, D] int8, feats [N, D] f32, keys [N] object,
+        row_list [N] int32, scale [N] f32, zero [N] f32)``. A list that
+        fails verification is rebuilt from the committed store (or
+        degraded when rebuilding is off)."""
+        by_id = {int(e["list"]): e for e in self.ann.lists}
+        parts: list[tuple] = []
+        for list_id in self.owned_lists:
+            entry = by_id.get(list_id)
+            if entry is None:
+                raise AnnError(f"ann manifest has no list {list_id}")
+            loaded = self.ann.load_list(entry)
+            if loaded is None and self.rebuild_corrupt:
+                annmod.rebuild_list(self.store_dir, list_id)
+                fresh = AnnIndexReader(self.store_dir)
+                fresh_entry = {int(e["list"]): e
+                               for e in fresh.lists}[list_id]
+                loaded = fresh.load_list(fresh_entry)
+            if loaded is None:
+                log.warning("annindex: list %d unavailable after "
+                            "quarantine — degrading to the surviving "
+                            "lists", list_id)
+                continue
+            codes, feats, keys, scale, zero = loaded
+            n = codes.shape[0]
+            if n == 0:
+                continue
+            parts.append((codes, feats, keys,
+                          np.full((n,), list_id, np.int32),
+                          np.full((n,), scale, np.float32),
+                          np.full((n,), zero, np.float32)))
+        if not parts:
+            dim = self.ann.embed_dim
+            return (np.zeros((0, dim), np.int8),
+                    np.zeros((0, dim), np.float32),
+                    np.zeros((0,), dtype=object),
+                    np.zeros((0,), np.int32), np.zeros((0,), np.float32),
+                    np.zeros((0,), np.float32))
+        return tuple(np.concatenate([p[i] for p in parts])
+                     for i in range(6))
+
+    def _pad_segment(self, codes, row_list, scale, zero, n):
+        s = self.segment_rows
+        valid = np.zeros((s,), bool)
+        valid[:n] = True
+        if n < s:
+            dim = codes.shape[1]
+            codes = np.concatenate(
+                [codes, np.zeros((s - n, dim), np.int8)])
+            row_list = np.concatenate(
+                [row_list, np.zeros((s - n,), np.int32)])
+            scale = np.concatenate([scale, np.ones((s - n,), np.float32)])
+            zero = np.concatenate([zero, np.zeros((s - n,), np.float32)])
+        return codes, row_list, scale, zero, valid
+
+    def build(self) -> "AnnEngine":
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from dcr_tpu.parallel.mesh import DATA_AXIS, FSDP_AXIS
+
+        if self._built:
+            return self
+        self._centroids = self.ann.load_centroids()
+        self.owned_lists = self._owned()
+        codes, feats, keys, row_list, scale, zero = self._load_owned_lists()
+        self._feats = feats
+        self._keys = keys
+        n_owned = codes.shape[0]
+        self._row_sharding = NamedSharding(self.mesh,
+                                           P((DATA_AXIS, FSDP_AXIS)))
+        self._q_sharding = NamedSharding(self.mesh, P())
+        dim = self.ann.embed_dim
+        host_segments = []
+        for start in range(0, max(n_owned, 1), self.segment_rows):
+            end = min(start + self.segment_rows, n_owned)
+            n = end - start
+            host_segments.append(
+                self._pad_segment(codes[start:end], row_list[start:end],
+                                  scale[start:end], zero[start:end], n)
+                + (start,))
+            self._seg_lists.append(set(row_list[start:end].tolist()))
+        self.num_segments = len(host_segments)
+        k_short = min(self.shortlist_k, self.segment_rows)
+        scan_jit = make_ivf_scan(k_short)
+        codes_aval = jax.ShapeDtypeStruct((self.segment_rows, dim),
+                                          jnp.int8,
+                                          sharding=self._row_sharding)
+        vec_aval = jax.ShapeDtypeStruct((self.segment_rows,), jnp.float32,
+                                        sharding=self._row_sharding)
+        rl_aval = jax.ShapeDtypeStruct((self.segment_rows,), jnp.int32,
+                                       sharding=self._row_sharding)
+        valid_aval = jax.ShapeDtypeStruct((self.segment_rows,), jnp.bool_,
+                                          sharding=self._row_sharding)
+        probed_aval = jax.ShapeDtypeStruct(
+            (self.query_batch, self.ann.n_lists), jnp.bool_,
+            sharding=self._q_sharding)
+        q_aval = jax.ShapeDtypeStruct((self.query_batch, dim), jnp.float32,
+                                      sharding=self._q_sharding)
+        cache = warmcache.WarmCache(self.warm_dir) if self.warm_dir else None
+        res = warmcache.aot_compile(
+            "search/ivf_scan", scan_jit,
+            (codes_aval, vec_aval, vec_aval, rl_aval, valid_aval,
+             probed_aval, q_aval),
+            static_config={
+                "shortlist_k": k_short, "segment_rows": self.segment_rows,
+                "query_batch": self.query_batch, "embed_dim": dim,
+                "n_lists": self.ann.n_lists,
+                "row_shards": self._row_shards,
+            }, cache=cache)
+        self._scan_fn = warmcache.guarded(res.fn, scan_jit,
+                                          "search/ivf_scan")
+        # exact f32 re-rank through the EXISTING search/topk program — a
+        # new shape variant, not a new program: ann off compiles byte-for-
+        # byte the original exact-path variants
+        kr = min(self.top_k, self.rerank_rows)
+        rr_jit = make_topk(kr, False)
+        rr_feats = jax.ShapeDtypeStruct((self.rerank_rows, dim),
+                                        jnp.float32,
+                                        sharding=self._row_sharding)
+        rr_valid = jax.ShapeDtypeStruct((self.rerank_rows,), jnp.bool_,
+                                        sharding=self._row_sharding)
+        rres = warmcache.aot_compile(
+            "search/topk", rr_jit, (rr_feats, rr_valid, q_aval),
+            static_config={
+                "top_k": kr, "segment_rows": self.rerank_rows,
+                "query_batch": self.query_batch, "embed_dim": dim,
+                "normalize_queries": False,
+                "row_shards": self._row_shards,
+            }, cache=cache)
+        self._rerank_fn = warmcache.guarded(rres.fn, rr_jit, "search/topk")
+        self.resident = n_owned <= max(self.max_resident_rows,
+                                       self.segment_rows)
+        if self.resident:
+            self._segments = [self._put_segment(seg)
+                              for seg in host_segments]
+        else:
+            self._segments = host_segments
+        self._built = True
+        reg = tracing.registry()
+        reg.gauge("ann/index_rows").set(self.ann.total)
+        reg.gauge("ann/lists").set(self.ann.n_lists)
+        reg.gauge("ann/owned_lists").set(len(self.owned_lists))
+        reg.gauge("ann/segments").set(self.num_segments)
+        reg.gauge("ann/nprobe").set(self.nprobe)
+        log.info("annindex: ready — %d/%d rows owned (%d/%d lists) in %d "
+                 "segment(s) of %d, nprobe=%d, shortlist=%d, top_k=%d "
+                 "(%s, scan %s, rerank %s)", n_owned, self.ann.total,
+                 len(self.owned_lists), self.ann.n_lists,
+                 self.num_segments, self.segment_rows, self.nprobe,
+                 self.shortlist_k, self.top_k,
+                 "device-resident" if self.resident else "host-streamed",
+                 res.source, rres.source)
+        return self
+
+    def _put_segment(self, seg):
+        import jax
+
+        codes, row_list, scale, zero, valid, start = seg
+        return (jax.device_put(codes, self._row_sharding),
+                jax.device_put(row_list, self._row_sharding),
+                jax.device_put(scale, self._row_sharding),
+                jax.device_put(zero, self._row_sharding),
+                jax.device_put(valid, self._row_sharding), start)
+
+    # -- query ---------------------------------------------------------------
+
+    def _probe(self, q: np.ndarray, nprobe: int) -> np.ndarray:
+        """Per-query nearest ``nprobe`` centroids, host-side (stable
+        order — same tie discipline as every merge in this repo)."""
+        scores = (q @ self._centroids.T
+                  - 0.5 * np.sum(self._centroids * self._centroids,
+                                 axis=-1)[None, :])
+        return np.argsort(-scores, axis=1, kind="stable")[:, :nprobe]
+
+    def query(self, q: np.ndarray, *, nprobe: int = 0
+              ) -> tuple[np.ndarray, np.ndarray]:
+        """Approximate top-k of every query row against the whole store:
+        same [n, K] desc table contract as the exact engine (scores are
+        exact f32 dot products of the re-ranked shortlist; only the
+        CANDIDATE SET is approximate). ``nprobe`` overrides the engine
+        default per call."""
+        if not self._built:
+            self.build()
+        q = np.asarray(q, np.float32)
+        if q.ndim != 2 or q.shape[1] != self.ann.embed_dim:
+            raise ValueError(
+                f"queries must be [n, {self.ann.embed_dim}], got {q.shape}")
+        n = q.shape[0]
+        out_scores = np.full((n, self.top_k), -np.inf, np.float32)
+        out_keys = np.full((n, self.top_k), "", dtype=object)
+        if n == 0:
+            return self._merge_hosts(out_scores, out_keys)
+        nprobe = max(1, min(int(nprobe) or self.nprobe, self.ann.n_lists))
+        reg = tracing.registry()
+        reg.counter("ann/query_total").inc()
+        reg.counter("ann/query_rows_total").inc(n)
+        reg.gauge("ann/nprobe").set(nprobe)
+        qn = normalize_rows(q) if self.normalize_queries else q
+        probes = self._probe(qn, nprobe)
+        # probe-locality grouping: queries sharing a top centroid land in
+        # the same chunk, so the chunk's probed-list union stays small and
+        # whole segments skip — this is the sublinear-scan lever
+        order = np.argsort(probes[:, 0], kind="stable")
+        for start in range(0, n, self.query_batch):
+            sel = order[start:start + self.query_batch]
+            s, k = self._query_chunk(qn[sel], probes[sel], nprobe)
+            out_scores[sel] = s
+            out_keys[sel] = k
+        return self._merge_hosts(out_scores, out_keys)
+
+    def _query_chunk(self, q: np.ndarray, probes: np.ndarray, nprobe: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        import jax
+
+        m = q.shape[0]
+        b = self.query_batch
+        if m < b:
+            q = np.concatenate([q, np.repeat(q[-1:], b - m, axis=0)])
+            probes = np.concatenate(
+                [probes, np.repeat(probes[-1:], b - m, axis=0)])
+        probed = np.zeros((b, self.ann.n_lists), bool)
+        np.put_along_axis(probed, probes, True, axis=1)
+        probed_union = set(np.unique(probes[:m]).tolist())
+        q_dev = jax.device_put(q, self._q_sharding)
+        probed_dev = jax.device_put(probed, self._q_sharding)
+        k_short = min(self.shortlist_k, self.segment_rows)
+        short_scores = np.full((m, k_short), -np.inf, np.float32)
+        short_rows = np.full((m, k_short), -1, np.int64)
+        reg = tracing.registry()
+        scanned = skipped = 0
+        for si, seg in enumerate(self._segments):
+            hit = self._seg_lists[si] & probed_union
+            if not hit:
+                skipped += 1
+                continue
+            seg = seg if self.resident else self._put_segment(seg)
+            codes, row_list, scale, zero, valid, seg_start = seg
+            with tracing.span("search/ivf_scan", segment=si, batch=m,
+                              nprobe=nprobe, lists=len(hit),
+                              rows=self.segment_rows,
+                              index_size=self.ann.total):
+                s, idx = self._scan_fn(codes, scale, zero, row_list, valid,
+                                       probed_dev, q_dev)
+                s = np.asarray(s)[:m]
+                idx = np.asarray(idx)[:m]
+            scanned += 1
+            reg.counter("ann/lists_scanned_total").inc(len(hit))
+            rows = np.where(np.isneginf(s), -1,
+                            seg_start + idx.astype(np.int64))
+            short_scores, short_rows = _merge_shortlist(
+                short_scores, short_rows, s, rows, k_short)
+        reg.counter("ann/segments_scanned_total").inc(scanned)
+        reg.counter("ann/segments_skipped_total").inc(skipped)
+        scores, keys = self._rerank(q_dev, short_rows, m)
+        tracing.event("ann/query_funnel", batch=m, nprobe=nprobe,
+                      lists_probed=len(probed_union),
+                      segments_scanned=scanned, segments_skipped=skipped,
+                      shortlist=int((short_rows[:m] >= 0).sum()),
+                      top_k=self.top_k)
+        return scores, keys
+
+    def _rerank(self, q_dev, short_rows: np.ndarray, m: int
+                ) -> tuple[np.ndarray, np.ndarray]:
+        """Exact f32 re-rank of the chunk's candidate union through the
+        ``search/topk`` program at the fixed ``rerank_rows`` shape."""
+        import jax
+
+        out_scores = np.full((m, self.top_k), -np.inf, np.float32)
+        out_keys = np.full((m, self.top_k), "", dtype=object)
+        cand = np.unique(short_rows[short_rows >= 0])
+        if cand.size == 0:
+            return out_scores, out_keys
+        cand = cand[:self.rerank_rows]  # bounded by B*shortlist_k anyway
+        nc = int(cand.size)
+        dim = self.ann.embed_dim
+        feats = np.zeros((self.rerank_rows, dim), np.float32)
+        feats[:nc] = self._feats[cand]
+        valid = np.zeros((self.rerank_rows,), bool)
+        valid[:nc] = True
+        reg = tracing.registry()
+        reg.counter("ann/rerank_rows_total").inc(nc)
+        with tracing.span("search/ivf_rerank", candidates=nc, batch=m,
+                          rows=self.rerank_rows):
+            s, idx = self._rerank_fn(
+                jax.device_put(feats, self._row_sharding),
+                jax.device_put(valid, self._row_sharding), q_dev)
+            s = np.asarray(s)[:m]
+            idx = np.asarray(idx)[:m]
+        kr = s.shape[1]
+        keys = np.where(np.isneginf(s), "",
+                        self._keys[cand[np.clip(idx, 0, nc - 1)]])
+        out_scores[:, :kr] = s
+        out_keys[:, :kr] = keys
+        return out_scores, out_keys
+
+    def query_rows(self, q: np.ndarray, feats: np.ndarray,
+                   keys: Sequence[str]) -> tuple[np.ndarray, np.ndarray]:
+        """EXACT top-k of ``q`` against ad-hoc rows (the live WAL tail)
+        through the engine's f32 re-rank program — tail rows are few and
+        not in any inverted list, so they are scanned exactly, and their
+        scores merge with :meth:`query`'s re-ranked (also exact) scores
+        on equal terms. Rows follow the index's normalization convention."""
+        if not self._built:
+            self.build()
+        import jax
+
+        q = np.asarray(q, np.float32)
+        feats = np.asarray(feats, np.float32)
+        keys_arr = np.asarray(keys, dtype=object)
+        dim = self.ann.embed_dim
+        if q.ndim != 2 or q.shape[1] != dim:
+            raise ValueError(f"queries must be [n, {dim}], got {q.shape}")
+        if feats.ndim != 2 or feats.shape[1] != dim:
+            raise ValueError(
+                f"tail rows must be [n, {dim}], got {feats.shape}")
+        if len(keys_arr) != feats.shape[0]:
+            raise ValueError(f"{feats.shape[0]} tail rows but "
+                             f"{len(keys_arr)} keys")
+        n = q.shape[0]
+        out_scores = np.full((n, self.top_k), -np.inf, np.float32)
+        out_keys = np.full((n, self.top_k), "", dtype=object)
+        if n == 0 or feats.shape[0] == 0:
+            return out_scores, out_keys
+        if self.ann.normalized:
+            feats = normalize_rows(feats)
+        qn = normalize_rows(q) if self.normalize_queries else q
+        b = self.query_batch
+        for qs in range(0, n, b):
+            chunk = qn[qs:qs + b]
+            m = chunk.shape[0]
+            if m < b:
+                chunk = np.concatenate(
+                    [chunk, np.repeat(chunk[-1:], b - m, axis=0)])
+            q_dev = jax.device_put(chunk, self._q_sharding)
+            for rs in range(0, feats.shape[0], self.rerank_rows):
+                part = feats[rs:rs + self.rerank_rows]
+                pk = keys_arr[rs:rs + self.rerank_rows]
+                nc = part.shape[0]
+                pad = np.zeros((self.rerank_rows, dim), np.float32)
+                pad[:nc] = part
+                valid = np.zeros((self.rerank_rows,), bool)
+                valid[:nc] = True
+                with tracing.span("search/ivf_rerank", candidates=nc,
+                                  batch=m, rows=self.rerank_rows,
+                                  tail=True):
+                    s, idx = self._rerank_fn(
+                        jax.device_put(pad, self._row_sharding),
+                        jax.device_put(valid, self._row_sharding), q_dev)
+                    s = np.asarray(s)[:m]
+                    idx = np.asarray(idx)[:m]
+                seg_keys = np.where(np.isneginf(s), "",
+                                    pk[np.clip(idx, 0, nc - 1)])
+                sl = slice(qs, qs + m)
+                out_scores[sl], out_keys[sl] = merge_topk(
+                    out_scores[sl], out_keys[sl], s, seg_keys)
+        return out_scores, out_keys
+
+    # -- multi-host merge (KV control plane) ---------------------------------
+
+    def _merge_hosts(self, scores: np.ndarray, keys: np.ndarray
+                     ) -> tuple[np.ndarray, np.ndarray]:
+        """Merge host-local tables across owners. CPU PJRT can't compile
+        cross-process programs, so the merge rides the coordination
+        service's KV store (:func:`dist.kv_allgather`) — rank order, so
+        every host lands on the identical merged table."""
+        from dcr_tpu.core import dist
+
+        if dist.process_count() <= 1:
+            return scores, keys
+        payload = json.dumps({
+            "scores": base64.b64encode(
+                np.ascontiguousarray(scores, "<f4").tobytes()).decode(),
+            "shape": list(scores.shape),
+            "keys": [[str(k) for k in row] for row in keys],
+        })
+        with tracing.span("search/ivf_merge", rows=int(scores.shape[0]),
+                          hosts=dist.process_count()):
+            blobs = dist.kv_allgather(
+                payload, tag="ann-merge",
+                timeout_s=dist.default_allgather_timeout_s())
+        out_s: Optional[np.ndarray] = None
+        out_k: Optional[np.ndarray] = None
+        for blob in blobs:
+            doc = json.loads(blob)
+            s = np.frombuffer(base64.b64decode(doc["scores"]),
+                              "<f4").reshape(doc["shape"]).copy()
+            k = np.asarray(doc["keys"], dtype=object).reshape(doc["shape"])
+            if out_s is None:
+                out_s, out_k = s, k
+            else:
+                out_s, out_k = merge_topk(out_s, out_k, s, k)
+        return out_s, out_k
+
+
+def spot_check_recall(engine: AnnEngine, exact_engine, q: np.ndarray,
+                      *, k: int = 10, nprobe: int = 0) -> float:
+    """recall@k of the ann engine against the exact oracle on ``q``,
+    emitted as an ``ann/recall_spot_check`` event (the trace_report ANN
+    section renders it) and an ``ann/recall_spot_pct`` gauge."""
+    a_scores, a_keys = engine.query(q, nprobe=nprobe)
+    e_scores, e_keys = exact_engine.query(q)
+    kk = min(k, a_keys.shape[1], e_keys.shape[1])
+    hits = total = 0
+    for arow, erow in zip(a_keys, e_keys):
+        truth = set(x for x in erow[:kk] if x)
+        if not truth:
+            continue
+        hits += len(truth & set(arow[:kk].tolist()))
+        total += len(truth)
+    recall = hits / total if total else 1.0
+    tracing.event("ann/recall_spot_check", k=kk, queries=int(q.shape[0]),
+                  recall=round(recall, 4),
+                  nprobe=int(nprobe) or engine.nprobe)
+    tracing.registry().gauge("ann/recall_spot_pct").set(
+        int(round(recall * 100)))
+    return recall
+
+
+def open_ann_engine(store_dir, *, mesh=None, top_k: int = 1,
+                    nprobe: int = DEFAULT_NPROBE, query_batch: int = 64,
+                    shortlist_k: int = DEFAULT_SHORTLIST_K,
+                    segment_rows: int = 0,
+                    normalize_queries: bool = False,
+                    require_normalized_rows: bool = False,
+                    warm_dir: str = "", build: bool = True) -> AnnEngine:
+    """Reader + engine in one call (the CLI/serve convenience)."""
+    engine = AnnEngine(
+        store_dir, mesh=mesh, top_k=top_k, nprobe=nprobe,
+        query_batch=query_batch, shortlist_k=shortlist_k,
+        segment_rows=segment_rows, normalize_queries=normalize_queries,
+        require_normalized_rows=require_normalized_rows, warm_dir=warm_dir)
+    return engine.build() if build else engine

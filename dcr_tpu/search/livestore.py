@@ -72,6 +72,7 @@ import numpy as np
 
 from dcr_tpu.core import resilience as R
 from dcr_tpu.core import tracing
+from dcr_tpu.search import ann
 from dcr_tpu.search.store import (CURRENT_NAME, DEFAULT_LEASE_S,
                                   DEFAULT_SHARD_ROWS, EmbeddingStoreWriter,
                                   MANIFEST_NAME, StoreError, StoreWriterLease,
@@ -499,7 +500,7 @@ class LiveStore:
                      if seq > self._wal_through]
             if not folds:
                 return {"folded_rows": 0, "records": 0,
-                        "snapshot": self.snapshot}
+                        "snapshot": self.snapshot, "ann_lists_folded": 0}
             folded_files = sorted(p for p in _wal_dir(self.dir).glob(
                 "wal_*.log") if p.name != _segment_name(self._active_index))
             rows = sum(f.shape[0] for _, f, _ in folds)
@@ -532,6 +533,32 @@ class LiveStore:
                 self.committed_total = writer._total
                 self._wal_through = last_seq
                 self.snapshot = snapshot_version(self.dir)
+                # dcr-ann: the same rows fold into their inverted lists
+                # incrementally (only affected lists rewrite). Ordering
+                # matters: the store commit above happened FIRST, so the
+                # ann tier's rebuild-from-store path can always re-derive
+                # a damaged list — folded rows are never ann-only. An ann
+                # fold failure degrades (the index lags; the exact path
+                # and the next fold are unaffected), never blocks
+                # compaction.
+                ann_folded = 0
+                if ann.has_ann_index(self.dir):
+                    try:
+                        fold_feats = np.concatenate(
+                            [f for _, f, _ in folds])
+                        fold_keys = np.concatenate(
+                            [np.asarray([str(k) for k in ks], dtype=object)
+                             for _, _, ks in folds])
+                        ann_report = ann.fold_rows(self.dir, fold_feats,
+                                                   fold_keys)
+                        ann_folded = int(ann_report["lists_rewritten"])
+                    except (StoreError, OSError) as e:
+                        R.log_event("ann_fold_failed", error=repr(e),
+                                    rows=rows)
+                        tracing.registry().counter(
+                            "ann/fold_failed").inc()
+                        log.warning("compact: ann fold failed (%r) — the "
+                                    "ann tier lags this snapshot", e)
                 for path in folded_files:
                     try:
                         path.unlink()
@@ -547,6 +574,7 @@ class LiveStore:
             return {"folded_rows": rows, "records": len(folds),
                     "snapshot": self.snapshot, "wal_through": last_seq,
                     "manifest": str(manifest),
+                    "ann_lists_folded": ann_folded,
                     "wal_segments_deleted": len(folded_files)}
 
     def _prune_locked(self, through_seq: int) -> None:

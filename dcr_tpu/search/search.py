@@ -194,19 +194,75 @@ def search_store(gen_features: np.ndarray, gen_keys: Sequence[str],
             "gen_images": np.asarray(list(gen_keys), dtype=object)}
 
 
+def search_store_ann(gen_features: np.ndarray, gen_keys: Sequence[str],
+                     store_dir: str | Path, *, top_k: int = 1, mesh=None,
+                     nprobe: int = 0, shortlist_k: int = 0,
+                     query_batch: int = 64, segment_rows: int = 0,
+                     live: bool = False, warm_dir: str = "") -> dict:
+    """The dcr-ann path of :func:`search_store`: nprobe-bounded IVF scan
+    over int8 inverted lists with exact f32 re-ranking
+    (:mod:`dcr_tpu.search.annindex`) — sublinear in corpus size, gated on
+    recall against the exact oracle by tools/bench_ann.py. ``live`` also
+    scans the WAL tail exactly (tail rows are in no inverted list) and
+    merges. Same result contract as every other path."""
+    from dcr_tpu.search.annindex import (DEFAULT_NPROBE, DEFAULT_SHORTLIST_K,
+                                         open_ann_engine)
+    from dcr_tpu.search.shardindex import merge_topk
+
+    n = len(gen_features)
+    if n == 0:
+        return {"scores": np.zeros((0, top_k), np.float32),
+                "keys": np.zeros((0, top_k), dtype=object),
+                "gen_images": np.asarray([], dtype=object)}
+    engine = open_ann_engine(
+        store_dir, mesh=mesh, top_k=top_k,
+        nprobe=int(nprobe) or DEFAULT_NPROBE,
+        shortlist_k=int(shortlist_k) or DEFAULT_SHORTLIST_K,
+        query_batch=query_batch, segment_rows=segment_rows,
+        warm_dir=warm_dir)
+    q = np.asarray(gen_features, np.float32)
+    t0 = time.time()
+    scores, keys = engine.query(q)
+    if live:
+        from dcr_tpu.search.livestore import load_wal_tail
+
+        tail_feats, tail_keys, _stats = load_wal_tail(
+            store_dir, after_seq=engine.reader.wal_through,
+            embed_dim=engine.reader.embed_dim)
+        if len(tail_feats):
+            t_scores, t_keys = engine.query_rows(q, tail_feats, tail_keys)
+            scores, keys = merge_topk(scores, keys, t_scores, t_keys)
+    log.info("ann search: %d queries x %d rows (nprobe=%d) in %.1fs", n,
+             engine.total, engine.nprobe, time.time() - t0)
+    return {"scores": scores, "keys": keys,
+            "gen_images": np.asarray(list(gen_keys), dtype=object)}
+
+
 def run_search(cfg: SearchConfig, *,
                laion_folders: Sequence[str | Path] = (),
                top_k: int = 1) -> Path:
-    """Full stage: load gen embeddings, search (store-backed when
-    ``cfg.store_dir`` names a built store, else the per-folder brute
-    force), dump results."""
+    """Full stage: load gen embeddings, search (ann tier when ``cfg.ann``,
+    store-backed when ``cfg.store_dir`` names a built store, else the
+    per-folder brute force), dump results."""
     gen_emb = find_embedding_file(cfg.gen_folder)
     if gen_emb is None:
         raise FileNotFoundError(
             f"no embedding dump under {cfg.gen_folder}; run search.embed first")
     gen_features, gen_keys = load_embeddings(gen_emb)
     top_k = max(top_k, cfg.top_k)
-    if cfg.store_dir and cfg.live:
+    if cfg.ann:
+        if not cfg.store_dir:
+            raise ValueError("--search.ann needs --search.store_dir (the "
+                             "IVF tier indexes a built store)")
+        from dcr_tpu.parallel import mesh as pmesh
+
+        result = search_store_ann(
+            gen_features, gen_keys, cfg.store_dir, top_k=top_k,
+            mesh=pmesh.make_mesh(cfg.mesh), nprobe=cfg.nprobe,
+            shortlist_k=cfg.shortlist_k, query_batch=cfg.query_batch,
+            segment_rows=cfg.segment_rows, live=cfg.live,
+            warm_dir=cfg.warm_dir)
+    elif cfg.store_dir and cfg.live:
         # dcr-live: committed snapshot + WAL tail, merged (livestore.py)
         from dcr_tpu.parallel import mesh as pmesh
         from dcr_tpu.search.livestore import query_live

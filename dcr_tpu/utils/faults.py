@@ -94,6 +94,22 @@ coordinated-recovery tests. Supported kinds and their hook points:
   Recovery proves the previous snapshot still serves, the WAL replays, and
   the next compaction overwrites the orphaned manifest cleanly.
   ``compact_crash@seal=0`` kills the first compaction.
+- ``ivf_list_corrupt`` — ann inverted-list load (search/ann.py), coord
+  ``load`` (per-reader list read index): damages the just-read list bytes
+  in memory so the sha256 verification fails like real bit rot — the list
+  is quarantine-renamed, an ``ann/ivf_list_corrupt`` counter bumps, and
+  the engine REBUILDS the list from the committed store (a list is a
+  projection of the store, never the only copy). This is how CI proves a
+  damaged ann tier degrades to a rebuild instead of crashing a query or
+  silently shrinking the candidate set. ``ivf_list_corrupt@load=0``
+  poisons the first list read.
+- ``kmeans_nan`` — IVF training Lloyd loop (search/ann.py train_ivf),
+  coord ``iter`` (per-run Lloyd iteration index): poisons the next
+  centroid update with non-finite values, driving the bounded
+  seed-shifted restart path — the restart is counted
+  (``ann/kmeans_restart``) and a run that exhausts its restarts raises a
+  typed ``AnnError`` instead of committing NaN centroids.
+  ``kmeans_nan@iter=1`` poisons the second iteration.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

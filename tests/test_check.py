@@ -694,6 +694,8 @@ def test_repo_registered_surfaces_match_expectations():
         "risk/score": True,         # dcr-watch online copy-risk top-k
         "search/matmul": True,      # the LAION brute-force search kernel
         "search/topk": True,        # dcr-store mesh-sharded store top-k
+        "search/kmeans": True,      # dcr-ann IVF quantizer Lloyd step
+        "search/ivf_scan": True,    # dcr-ann nprobe-bounded list scan
     }
 
 
@@ -717,6 +719,9 @@ def test_checked_in_manifest_covers_acceptance_surfaces():
     assert by_surface["sample/sampler"] == {"ddim", "dpm++", "ddpm",
                                             "dpm++-fast"}
     assert "default" in by_surface["eval/embed"]
+    # dcr-ann: both approximate-tier surfaces are fingerprinted
+    assert "default" in by_surface["search/kmeans"]
+    assert "default" in by_surface["search/ivf_scan"]
     for entry in entries.values():
         assert entry["lowered_sha256"] and entry["in_avals"]["leaves"] > 0
         # every serve bucket records the default bucket's static knobs —

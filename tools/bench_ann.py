@@ -1,0 +1,312 @@
+"""Approximate-search bench: IVF + int8 tier vs the exact sharded top-k
+(dcr-ann, ISSUE 19).
+
+Builds a synthetic CLUSTERED SSCD-width corpus — cluster centers plus
+small noise, queries drawn as perturbed corpus rows from a SUBSET of hot
+clusters. Both choices are deliberate: an IVF quantizer over isotropic
+gaussian noise has nothing to learn (every list is equidistant, recall
+collapses, probes don't localize), and uniformly-spread queries defeat
+segment skipping (every query chunk's probed-list union touches every
+segment). Real embedding corpora are strongly clustered and real serve
+traffic is bursty — the copy-risk workload scores batches of similar
+generations — so the synthetic workload has to reproduce the structure
+the index exploits or the bench measures nothing.
+
+The SAME query set then runs through both engines over the SAME store:
+
+- **exact**: the mesh-sharded ``search/topk`` engine (dcr-store) — every
+  committed row scanned per query; the correctness oracle;
+- **ann**: ``dcr-search train-ivf`` once (banked as ``train_seconds`` —
+  training is paid per corpus, not per query), then the ``search/ivf_scan``
+  engine: nprobe-bounded int8 inverted-list probes with the shortlist
+  re-ranked in f32 through the exact program.
+
+Banked per nprobe: recall@k against the exact oracle and the speedup —
+the recall-vs-cost curve an operator tunes ``--nprobe`` on. Gates (full
+mode, at the default operating point ``BENCH_ANN_NPROBE``):
+
+- recall@``BENCH_ANN_TOPK`` >= ``MIN_ANN_RECALL`` (0.95), and
+- query throughput >= ``MIN_ANN_SPEEDUP`` (5x) over exact,
+
+or exit 1. Both modes additionally pin the EXACT path bit-identical
+(scores AND keys) between this store — which carries a trained ann tier
+under ``<store>/ann/`` — and a clean copy without one: the ann tier's
+presence on disk must be invisible to ann-off queries.
+
+``--smoke`` (CI): small corpus; validates the JSON schema + the ann-off
+identity pin + that recall/speedup are recorded; the perf gates are
+recorded but not enforced (shared CI runners don't gate perf — the banked
+full run does). Results bank as BENCH_ANN.json.
+
+Usage: python tools/bench_ann.py [--smoke]
+Env knobs: BENCH_ANN_ROWS (default 131072; smoke 4096), BENCH_ANN_DIM
+(512; smoke 64), BENCH_ANN_CLUSTERS (256; smoke 16),
+BENCH_ANN_QUERY_CLUSTERS (16; smoke 4 — the hot clusters queries come
+from), BENCH_ANN_LISTS (256; smoke 16), BENCH_ANN_SEGMENT_ROWS (512;
+smoke 0 = engine default — the skip granule: ~one list per segment),
+BENCH_ANN_QUERIES (256; smoke 32), BENCH_ANN_TOPK (10), BENCH_ANN_NPROBE
+(8 — the gated operating point), BENCH_ANN_CURVE (comma-separated nprobe
+sweep, default "1,2,4,8,16"), BENCH_ANN_REPEATS (3; smoke 1),
+BENCH_ANN_MIN_RECALL (0.95), BENCH_ANN_MIN_SPEEDUP (5.0).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+OUT = Path(__file__).resolve().parent.parent / "BENCH_ANN.json"
+
+#: ISSUE 19 acceptance floors at the default operating point.
+MIN_ANN_RECALL = 0.95
+MIN_ANN_SPEEDUP = 5.0
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name) or default)
+
+
+def build_corpus(rows: int, dim: int, clusters: int, queries: int,
+                 query_clusters: int, seed: int = 0):
+    """Clustered corpus + queries that are perturbed corpus rows drawn
+    from ``query_clusters`` hot clusters (each query's true neighbors
+    live in its own cluster, and queries share probes — the bursty
+    workload IVF is built for)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    centers = rng.standard_normal((clusters, dim)).astype(np.float32) * 4.0
+    assign = rng.integers(0, clusters, rows)
+    feats = (centers[assign]
+             + rng.standard_normal((rows, dim)).astype(np.float32) * 0.25)
+    hot = rng.choice(clusters, min(query_clusters, clusters), replace=False)
+    pool = np.flatnonzero(np.isin(assign, hot))
+    picks = rng.choice(pool, queries, replace=len(pool) < queries)
+    q = (feats[picks]
+         + rng.standard_normal((queries, dim)).astype(np.float32) * 0.05)
+    return feats.astype(np.float32), q.astype(np.float32)
+
+
+def recall_at_k(ann_keys, exact_keys, k: int) -> float:
+    hits = total = 0
+    for arow, erow in zip(ann_keys, exact_keys):
+        truth = set(erow[:k].tolist())
+        hits += len(truth & set(arow[:k].tolist()))
+        total += len(truth)
+    return hits / max(total, 1)
+
+
+def _best(fn, repeats: int):
+    out = None
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return out, best
+
+
+def validate_result(doc: dict) -> list[str]:
+    """Schema problems with a BENCH_ANN document ([] = valid). Used by the
+    --smoke leg and tests/test_ann.py."""
+    problems: list[str] = []
+
+    def need(obj, field, types, where):
+        v = obj.get(field)
+        if not isinstance(v, types) or isinstance(v, bool) and types != bool:
+            problems.append(f"{where}.{field}: missing/wrong type")
+            return None
+        return v
+
+    need(doc, "version", int, "$")
+    cfg = need(doc, "config", dict, "$") or {}
+    for f in ("corpus_rows", "embed_dim", "clusters", "n_lists", "queries",
+              "top_k", "query_batch", "repeats"):
+        need(cfg, f, int, "$.config")
+    exact = need(doc, "exact", dict, "$") or {}
+    need(exact, "seconds", (int, float), "$.exact")
+    need(exact, "rows_per_s", (int, float), "$.exact")
+    ann = need(doc, "ann", dict, "$") or {}
+    for f in ("train_seconds", "seconds", "rows_per_s"):
+        need(ann, f, (int, float), "$.ann")
+    curve = need(doc, "recall_curve", list, "$") or []
+    if not curve:
+        problems.append("$.recall_curve: empty")
+    for i, row in enumerate(curve):
+        if not isinstance(row, dict):
+            problems.append(f"$.recall_curve[{i}]: not an object")
+            continue
+        need(row, "nprobe", int, f"$.recall_curve[{i}]")
+        need(row, "recall", (int, float), f"$.recall_curve[{i}]")
+        need(row, "seconds", (int, float), f"$.recall_curve[{i}]")
+        need(row, "speedup", (int, float), f"$.recall_curve[{i}]")
+    eq = need(doc, "equality", dict, "$") or {}
+    for f in ("exact_scores_equal", "exact_keys_equal"):
+        if not isinstance(eq.get(f), bool):
+            problems.append(f"$.equality.{f}: missing/not bool")
+    gate = need(doc, "gate", dict, "$") or {}
+    need(gate, "nprobe", int, "$.gate")
+    for f in ("min_recall", "recall", "min_speedup", "speedup"):
+        need(gate, f, (int, float), "$.gate")
+    for f in ("enforced", "passed"):
+        if not isinstance(gate.get(f), bool):
+            problems.append(f"$.gate.{f}: missing/not bool")
+    return problems
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    smoke = "--smoke" in argv
+
+    import numpy as np
+
+    from dcr_tpu.search import ann as annmod
+    from dcr_tpu.search.annindex import open_ann_engine
+    from dcr_tpu.search.shardindex import open_engine
+    from dcr_tpu.search.store import EmbeddingStoreWriter
+
+    rows = _env_int("BENCH_ANN_ROWS", 4096 if smoke else 131072)
+    dim = _env_int("BENCH_ANN_DIM", 64 if smoke else 512)
+    clusters = _env_int("BENCH_ANN_CLUSTERS", 16 if smoke else 256)
+    query_clusters = _env_int("BENCH_ANN_QUERY_CLUSTERS",
+                              4 if smoke else 8)
+    n_lists = _env_int("BENCH_ANN_LISTS", 16 if smoke else 256)
+    segment_rows = _env_int("BENCH_ANN_SEGMENT_ROWS", 0 if smoke else 512)
+    queries = _env_int("BENCH_ANN_QUERIES", 32 if smoke else 256)
+    top_k = _env_int("BENCH_ANN_TOPK", 10)
+    nprobe = _env_int("BENCH_ANN_NPROBE", 2)
+    curve_probes = [int(x) for x in
+                    (os.environ.get("BENCH_ANN_CURVE") or
+                     ("2,4" if smoke else "1,2,4,8,16")).split(",")]
+    repeats = _env_int("BENCH_ANN_REPEATS", 1 if smoke else 3)
+    # Small chunks preserve the engine's sorted-probe locality: queries are
+    # sorted by top probe, so a 64-query chunk from a bursty workload
+    # touches a handful of lists and skips the rest. One giant chunk would
+    # union every hot list and scan far more rows per query.
+    query_batch = _env_int("BENCH_ANN_QUERY_BATCH", min(queries, 64))
+    min_recall = float(os.environ.get("BENCH_ANN_MIN_RECALL")
+                       or MIN_ANN_RECALL)
+    min_speedup = float(os.environ.get("BENCH_ANN_MIN_SPEEDUP")
+                        or MIN_ANN_SPEEDUP)
+    if nprobe not in curve_probes:
+        curve_probes.append(nprobe)
+    print(f"bench_ann{' --smoke' if smoke else ''}: corpus {rows}x{dim} "
+          f"({clusters} clusters), {n_lists} lists, {queries} queries "
+          f"from {query_clusters} hot cluster(s), recall@{top_k}, "
+          f"nprobe curve {curve_probes}")
+
+    feats, q = build_corpus(rows, dim, clusters, queries, query_clusters)
+
+    with tempfile.TemporaryDirectory(prefix="bench_ann_") as td:
+        root = Path(td)
+        store = root / "store"
+        w = EmbeddingStoreWriter(store, embed_dim=dim, shard_rows=16384)
+        w.add(feats, [f"row{i}" for i in range(rows)])
+        w.finalize()
+
+        # exact oracle FIRST, against the ann-free store
+        engine = open_engine(store, top_k=top_k, query_batch=query_batch)
+        engine.query(q[:1])
+        (exact_scores, exact_keys), exact_s = _best(
+            lambda: engine.query(q), repeats)
+
+        # ann-off identity pin: snapshot the exact results, train the ann
+        # tier INTO the same store, and re-run the exact engine — the ann
+        # tier on disk must be invisible to the exact path (bit-identical
+        # scores AND keys)
+        t0 = time.perf_counter()
+        train_report = annmod.train_ivf(store, n_lists=n_lists, iters=10,
+                                        seed=0)
+        train_s = time.perf_counter() - t0
+        engine2 = open_engine(store, top_k=top_k, query_batch=query_batch)
+        engine2.query(q[:1])
+        re_scores, re_keys = engine2.query(q)
+        scores_equal = bool(np.array_equal(exact_scores, re_scores))
+        keys_equal = bool((exact_keys == re_keys).all())
+
+        aeng = open_ann_engine(store, top_k=top_k, nprobe=nprobe,
+                               query_batch=query_batch,
+                               shortlist_k=max(32, top_k),
+                               segment_rows=segment_rows)
+        aeng.query(q[:1])
+        curve = []
+        gate_row = None
+        for p in sorted(set(curve_probes)):
+            (a_scores, a_keys), a_s = _best(
+                lambda p=p: aeng.query(q, nprobe=p), repeats)
+            row = {"nprobe": int(p),
+                   "recall": round(recall_at_k(a_keys, exact_keys, top_k), 4),
+                   "seconds": round(a_s, 4),
+                   "speedup": round(exact_s / max(a_s, 1e-9), 3)}
+            curve.append(row)
+            print(f"bench_ann: nprobe={p:<3d} recall@{top_k} "
+                  f"{row['recall']:.4f}  {row['seconds']}s  "
+                  f"(speedup {row['speedup']}x)")
+            if p == nprobe:
+                gate_row = row
+
+        doc = {
+            "version": 1,
+            "config": {"corpus_rows": rows, "embed_dim": dim,
+                       "clusters": clusters,
+                       "query_clusters": query_clusters,
+                       "n_lists": n_lists,
+                       "segment_rows": int(aeng.segment_rows),
+                       "queries": queries, "top_k": top_k,
+                       "query_batch": query_batch, "repeats": repeats,
+                       "ivf_iters": int(train_report["iters"]),
+                       "segments": int(aeng.num_segments)},
+            "exact": {
+                "seconds": round(exact_s, 4),
+                "rows_per_s": round(queries * rows / max(exact_s, 1e-9)),
+            },
+            "ann": {
+                "train_seconds": round(train_s, 4),
+                "seconds": gate_row["seconds"],
+                "rows_per_s": round(queries * rows
+                                    / max(gate_row["seconds"], 1e-9)),
+            },
+            "recall_curve": curve,
+            "equality": {"exact_scores_equal": scores_equal,
+                         "exact_keys_equal": keys_equal},
+            "gate": {"nprobe": int(nprobe),
+                     "min_recall": min_recall,
+                     "recall": gate_row["recall"],
+                     "min_speedup": min_speedup,
+                     "speedup": gate_row["speedup"],
+                     "enforced": not smoke,
+                     "passed": bool(gate_row["recall"] >= min_recall
+                                    and gate_row["speedup"] >= min_speedup)},
+        }
+
+    problems = validate_result(doc)
+    OUT.write_text(json.dumps(doc, indent=1, sort_keys=True) + "\n")
+    print(f"bench_ann: exact {doc['exact']['seconds']}s vs ann "
+          f"{doc['ann']['seconds']}s at nprobe={nprobe} -> recall@{top_k} "
+          f"{doc['gate']['recall']} at {doc['gate']['speedup']}x "
+          f"(train {doc['ann']['train_seconds']}s, paid once) -> {OUT}")
+    if problems:
+        print("bench_ann: SCHEMA problems:\n  " + "\n  ".join(problems))
+        return 1
+    if not (scores_equal and keys_equal):
+        print("bench_ann: ANN-OFF IDENTITY FAILED — the exact path returned "
+              "different results once the ann tier existed on disk "
+              f"(scores_equal={scores_equal}, keys_equal={keys_equal})")
+        return 1
+    if not smoke and not doc["gate"]["passed"]:
+        print(f"bench_ann: GATE FAILED — recall {doc['gate']['recall']} "
+              f"(floor {min_recall}) at speedup {doc['gate']['speedup']}x "
+              f"(floor {min_speedup}x)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

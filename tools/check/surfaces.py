@@ -423,6 +423,57 @@ def _build_search_matmul() -> dict:
                 static_config={"embed_dim": EMBED_DIM})
 
 
+def _build_search_kmeans() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import SearchConfig
+    from dcr_tpu.obs.copyrisk import EMBED_DIM
+    from dcr_tpu.search.ann import DEFAULT_TRAIN_SEGMENT_ROWS, make_kmeans_step
+
+    scfg = SearchConfig()
+    # one Lloyd accumulation at the production defaults: n_lists centroids
+    # over one training segment of the SSCD-width corpus
+    seg_rows = DEFAULT_TRAIN_SEGMENT_ROWS
+    fn = make_kmeans_step(scfg.n_lists)
+    feats = jax.ShapeDtypeStruct((seg_rows, EMBED_DIM), jnp.float32)
+    valid = jax.ShapeDtypeStruct((seg_rows,), jnp.bool_)
+    cent = jax.ShapeDtypeStruct((scfg.n_lists, EMBED_DIM), jnp.float32)
+    return dict(fn=fn, args=(feats, valid, cent),
+                static_config={"n_lists": scfg.n_lists,
+                               "segment_rows": seg_rows,
+                               "embed_dim": EMBED_DIM})
+
+
+def _build_ivf_scan() -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from dcr_tpu.core.config import SearchConfig
+    from dcr_tpu.obs.copyrisk import EMBED_DIM
+    from dcr_tpu.search.annindex import DEFAULT_SEGMENT_ROWS, make_ivf_scan
+
+    scfg = SearchConfig()
+    # the nprobe-bounded int8 segment scan at the AnnEngine defaults (one
+    # device, so row_shards=1 — the sharded variants lower the same jaxpr)
+    seg_rows = DEFAULT_SEGMENT_ROWS
+    fn = make_ivf_scan(scfg.shortlist_k)
+    codes = jax.ShapeDtypeStruct((seg_rows, EMBED_DIM), jnp.int8)
+    vec = jax.ShapeDtypeStruct((seg_rows,), jnp.float32)
+    row_list = jax.ShapeDtypeStruct((seg_rows,), jnp.int32)
+    valid = jax.ShapeDtypeStruct((seg_rows,), jnp.bool_)
+    probed = jax.ShapeDtypeStruct((scfg.query_batch, scfg.n_lists),
+                                  jnp.bool_)
+    q = jax.ShapeDtypeStruct((scfg.query_batch, EMBED_DIM), jnp.float32)
+    return dict(fn=fn, args=(codes, vec, vec, row_list, valid, probed, q),
+                static_config={"shortlist_k": scfg.shortlist_k,
+                               "segment_rows": seg_rows,
+                               "query_batch": scfg.query_batch,
+                               "embed_dim": EMBED_DIM,
+                               "n_lists": scfg.n_lists,
+                               "row_shards": 1})
+
+
 SAMPLERS = ("ddim", "dpm++", "ddpm")
 
 SURFACES: tuple[SurfaceSpec, ...] = (
@@ -468,6 +519,14 @@ SURFACES: tuple[SurfaceSpec, ...] = (
                 _build_search_topk),
     SurfaceSpec("search/topk@risk", "search/topk", "risk",
                 lambda: _build_search_topk(True)),
+    # dcr-ann: the IVF tier's two device programs — the Lloyd training
+    # accumulation and the nprobe-bounded int8 inverted-list scan. The
+    # exact path's entries above are untouched by construction (ann off
+    # compiles byte-for-byte the original programs).
+    SurfaceSpec("search/kmeans@default", "search/kmeans", "default",
+                _build_search_kmeans),
+    SurfaceSpec("search/ivf_scan@default", "search/ivf_scan", "default",
+                _build_ivf_scan),
 )
 
 

@@ -438,6 +438,98 @@ def search_summary(records: list[dict]) -> dict | None:
     return out
 
 
+def ann_summary(records: list[dict]) -> dict | None:
+    """The "ANN" section (dcr-ann): IVF approximate-search health.
+
+    Built from the ``search/ivf_scan`` spans (one per probed segment scan:
+    nprobe, lists hit, segment rows), the ``search/ivf_rerank`` spans (the
+    exact f32 re-rank of the shortlist union), the ``ann/query_funnel``
+    events (the probe -> shortlist -> re-rank funnel per query chunk, plus
+    the segment skip ratio — the sublinearity evidence), the ``search/
+    kmeans`` spans (training Lloyd iterations), and the ``ann/
+    recall_spot_check`` events (sampled recall vs the exact oracle). None
+    when the ann tier never ran — other traces keep their shape.
+    """
+    scans = [r for r in records
+             if r["ph"] == "X" and r["name"] == "search/ivf_scan"]
+    reranks = [r for r in records
+               if r["ph"] == "X" and r["name"] == "search/ivf_rerank"]
+    kmeans = [r for r in records
+              if r["ph"] == "X" and r["name"] == "search/kmeans"]
+    funnels = [r for r in records
+               if r["ph"] == "i" and r["name"] == "ann/query_funnel"]
+    recalls = [r for r in records
+               if r["ph"] == "i" and r["name"] == "ann/recall_spot_check"]
+    if not scans and not kmeans and not funnels:
+        return None
+    out: dict = {}
+    if scans:
+        durs = sorted(r["dur"] / 1e3 for r in scans)
+        nprobes: dict[str, int] = {}
+        for r in scans:
+            key = str(r["args"].get("nprobe", "?"))
+            nprobes[key] = nprobes.get(key, 0) + 1
+        out["scan"] = {
+            "segment_scans": len(scans),
+            "lists_scanned": sum(int(r["args"].get("lists", 0))
+                                 for r in scans),
+            "rows_scanned": sum(int(r["args"].get("rows", 0))
+                                for r in scans),
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50), 3),
+            "p99_ms": round(_percentile(durs, 99), 3),
+            "nprobe_distribution": dict(sorted(nprobes.items(),
+                                               key=lambda kv: kv[0])),
+        }
+    if funnels:
+        scanned = sum(int(e["args"].get("segments_scanned", 0))
+                      for e in funnels)
+        skipped = sum(int(e["args"].get("segments_skipped", 0))
+                      for e in funnels)
+        out["funnel"] = {
+            "query_chunks": len(funnels),
+            "queries": sum(int(e["args"].get("batch", 0)) for e in funnels),
+            "lists_probed": sum(int(e["args"].get("lists_probed", 0))
+                                for e in funnels),
+            "shortlist_candidates": sum(int(e["args"].get("shortlist", 0))
+                                        for e in funnels),
+            "reranked_to_top_k": sum(
+                int(e["args"].get("batch", 0)) * int(e["args"].get("top_k", 1))
+                for e in funnels),
+            "segments_scanned": scanned,
+            "segments_skipped": skipped,
+            "segment_skip_pct": round(
+                100.0 * skipped / max(scanned + skipped, 1), 1),
+        }
+    if reranks:
+        durs = sorted(r["dur"] / 1e3 for r in reranks)
+        out["rerank"] = {
+            "calls": len(reranks),
+            "candidates": sum(int(r["args"].get("candidates", 0))
+                              for r in reranks),
+            "total_ms": round(sum(durs), 3),
+            "p50_ms": round(_percentile(durs, 50), 3),
+            "p99_ms": round(_percentile(durs, 99), 3),
+        }
+    if kmeans:
+        restarts = max((int(r["args"].get("restart", 0)) for r in kmeans),
+                       default=0)
+        out["train"] = {
+            "lloyd_iters": len(kmeans),
+            "restarts": restarts,
+            "total_ms": round(sum(r["dur"] for r in kmeans) / 1e3, 3),
+        }
+    if recalls:
+        vals = sorted(float(e["args"].get("recall", 0.0)) for e in recalls)
+        out["recall_spot_checks"] = {
+            "checks": len(recalls),
+            "k": int(recalls[-1]["args"].get("k", 0)),
+            "min_recall": round(vals[0], 4),
+            "mean_recall": round(sum(vals) / len(vals), 4),
+        }
+    return out
+
+
 def _fmt_ts(ts_us: float) -> str:
     return time.strftime("%H:%M:%S", time.localtime(ts_us / 1e6))
 
@@ -755,6 +847,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "compiles_per_incarnation": compiles_per_incarnation(records),
         "copy_risk": copy_risk_summary(records),
         "search": search_summary(records),
+        "ann": ann_summary(records),
         "ingest": ingest_summary(records),
         "fast_sampling": fast_sampling_summary(records),
         "pipeline": pipeline_summary(records),
@@ -906,6 +999,47 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
             lines.append(
                 f"  ingest: {ing['shards']} shard(s), {ing['rows']} rows in "
                 f"{ing['total_ms']} ms")
+    annsec = summary.get("ann")
+    if annsec:
+        lines.append("\nANN (IVF approximate search):")
+        scan = annsec.get("scan")
+        if scan:
+            lines.append(
+                f"  scan: {scan['segment_scans']} segment scan(s), "
+                f"{scan['lists_scanned']} list(s) over "
+                f"{scan['rows_scanned']} rows in {scan['total_ms']} ms  "
+                f"p50 {scan['p50_ms']} ms  p99 {scan['p99_ms']} ms")
+            dist = ", ".join(f"nprobe={k}: x{v}" for k, v in
+                             scan["nprobe_distribution"].items())
+            lines.append(f"  nprobe distribution: {dist}")
+        fun = annsec.get("funnel")
+        if fun:
+            lines.append(
+                f"  funnel: {fun['queries']} query(ies) probed "
+                f"{fun['lists_probed']} list(s) -> "
+                f"{fun['shortlist_candidates']} shortlist candidate(s) -> "
+                f"{fun['reranked_to_top_k']} re-ranked slot(s)")
+            lines.append(
+                f"  segments: {fun['segments_scanned']} scanned, "
+                f"{fun['segments_skipped']} skipped "
+                f"({fun['segment_skip_pct']}% skipped)")
+        rr = annsec.get("rerank")
+        if rr:
+            lines.append(
+                f"  re-rank: {rr['calls']} call(s), {rr['candidates']} "
+                f"candidate(s) in {rr['total_ms']} ms  p50 {rr['p50_ms']} ms"
+                f"  p99 {rr['p99_ms']} ms")
+        tr = annsec.get("train")
+        if tr:
+            lines.append(
+                f"  train: {tr['lloyd_iters']} Lloyd iteration(s), "
+                f"{tr['restarts']} restart(s), {tr['total_ms']} ms")
+        rc = annsec.get("recall_spot_checks")
+        if rc:
+            lines.append(
+                f"  recall spot-check: {rc['checks']} check(s) at "
+                f"k={rc['k']} — mean {rc['mean_recall']}, "
+                f"min {rc['min_recall']}")
     ing = summary.get("ingest")
     if ing:
         lines.append("\ningest:")
