@@ -384,6 +384,49 @@ class IngestConfig:
 
 
 @dataclass
+class SloConfig:
+    """dcr-slo (dcr_tpu/obs/slo.py): declarative service-level objectives
+    over the live provenance plane, evaluated by the fleet supervisor's
+    monitor loop from the existing worker scrape. Each objective compares
+    one signal (availability, queue-wait p99, shed rate, ingest lag, ANN
+    staleness, online ANN recall, copy-risk scoring coverage) against its
+    target and tracks the classic multi-window burn rate: the fraction of
+    recent samples violating the target, divided by the error ``budget``.
+    ``ok -> warn`` on the short window alone; ``-> breach`` only when BOTH
+    windows burn (a transient spike cannot page), back to ``ok`` below
+    ``recover_burn`` (hysteresis). State is exported as
+    ``dcr_slo_{burn_rate,state,breach_total}`` metrics, ``GET /slo``, and
+    ``slo/breach``/``slo/recover`` trace events; a breach sustained past
+    ``dump_after_s`` dumps the flight recorder."""
+
+    enabled: bool = True
+    short_window_s: float = 60.0   # fast-burn window (detection latency)
+    long_window_s: float = 300.0   # slow-burn window (spike suppression)
+    # burn thresholds, in units of budget-consumption rate: burn 1.0 means
+    # the window is violating at exactly the budgeted fraction
+    warn_burn: float = 1.0
+    breach_burn: float = 2.0
+    recover_burn: float = 0.5      # must drop BELOW warn_burn (hysteresis)
+    budget: float = 0.1            # allowed bad-sample fraction at burn 1.0
+    dump_after_s: float = 120.0    # sustained breach before a flight-rec dump
+    # objective targets; <= 0 disables that objective. The queue-wait p99
+    # objective reuses fleet.slo_queue_wait_p99_s (the shed threshold) as
+    # its target so alerting and shedding can never disagree.
+    availability_min: float = 0.75    # stale-scrape-aware alive fraction
+    shed_rate_max: float = 0.05       # shed/(accepted+shed) per window
+    ingest_lag_s_max: float = 30.0    # queue lag OR oldest-unfolded row age
+    ann_staleness_rows_max: float = 50000.0   # store rows not in IVF lists
+    recall_min: float = 0.80          # rolling online recall@k (probe)
+    coverage_min: float = 0.95        # scored generations / completed
+    # online recall probe (obs/recall_probe.py): every Nth ANN scoring call
+    # re-runs the batch through the shadow-exact oracle (all lists probed —
+    # the f32 re-rank is exact, so the candidate set is the whole corpus)
+    recall_probe_every_n: int = 32
+    recall_probe_k: int = 10
+    recall_probe_window: int = 64     # rolling samples behind the gauge
+
+
+@dataclass
 class OptimConfig:
     learning_rate: float = 5e-6
     adam_beta1: float = 0.9
@@ -553,6 +596,8 @@ class ServeConfig:
     # the reuse plan (per-request overrides can still request a dense or
     # differently-planned bucket within the compiled-bucket budget)
     fast: FastSampleConfig = field(default_factory=FastSampleConfig)
+    # dcr-slo: declarative SLOs evaluated by the fleet supervisor
+    slo: SloConfig = field(default_factory=SloConfig)
 
 
 def validate_serve_config(cfg: ServeConfig) -> None:
@@ -594,6 +639,36 @@ def validate_serve_config(cfg: ServeConfig) -> None:
     validate_risk_config(cfg.risk)
     validate_ingest_config(cfg)
     validate_fast_config(cfg.fast)
+    validate_slo_config(cfg.slo)
+
+
+def validate_slo_config(s: SloConfig) -> None:
+    if not s.enabled:
+        return
+    if s.short_window_s <= 0 or s.long_window_s <= 0:
+        raise ValueError("slo windows must be > 0 (a zero-width window has "
+                         "no samples to burn)")
+    if s.long_window_s < s.short_window_s:
+        raise ValueError("slo.long_window_s must be >= slo.short_window_s "
+                         "(the long window exists to veto short-window "
+                         "spikes; inverted windows would breach on noise)")
+    if s.budget <= 0 or s.budget > 1:
+        raise ValueError("slo.budget must be in (0, 1]: the allowed "
+                         "bad-sample fraction at burn rate 1.0")
+    if s.breach_burn < s.warn_burn:
+        raise ValueError("slo.breach_burn must be >= slo.warn_burn "
+                         "(breach is a worse state than warn)")
+    if s.recover_burn >= s.warn_burn:
+        raise ValueError("slo.recover_burn must be < slo.warn_burn: "
+                         "recovery needs hysteresis or the state flaps at "
+                         "the threshold")
+    if s.dump_after_s < 0:
+        raise ValueError("slo.dump_after_s must be >= 0")
+    if s.recall_probe_every_n < 1:
+        raise ValueError("slo.recall_probe_every_n must be >= 1")
+    if s.recall_probe_k < 1 or s.recall_probe_window < 1:
+        raise ValueError("slo.recall_probe_k and slo.recall_probe_window "
+                         "must be >= 1")
 
 
 def validate_ingest_config(cfg: ServeConfig) -> None:

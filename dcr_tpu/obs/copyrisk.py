@@ -305,6 +305,10 @@ class CopyRiskIndex:
         # rows; called with the engine snapshot's wal_through so committed
         # + tail is one consistent corpus
         self.live_tail = None
+        # dcr-slo: optional sampled shadow-exact recall probe
+        # (obs/recall_probe.RecallProbe); worker attaches it when the ANN
+        # tier serves so online recall is continuously observed
+        self.recall_probe = None
 
     def __len__(self) -> int:
         return self._store.total if self._store is not None \
@@ -546,6 +550,7 @@ class CopyRiskIndex:
         if engine is not None:
             sims, key_rows = engine.query(feats_n)
             tail_fn = self.live_tail
+            tail_feats = tail_keys = None
             if tail_fn is not None:
                 from dcr_tpu.search.shardindex import merge_topk
 
@@ -555,6 +560,26 @@ class CopyRiskIndex:
                         feats_n, tail_feats, tail_keys)
                     sims, key_rows = merge_topk(sims, key_rows,
                                                 tail_sims, tail_out)
+            if hasattr(engine, "ann"):
+                # dcr-slo: ANN staleness = store rows the inverted lists
+                # don't cover yet (committed-but-unfolded + live tail);
+                # these rows are still served exactly, but every one is a
+                # row the approximate candidate walk cannot return
+                stale = max(0, int(engine.reader.total) - int(engine.total))
+                if tail_feats is not None:
+                    stale += int(len(tail_feats))
+                tracing.registry().gauge("ann/staleness_rows").set(stale)
+                probe = self.recall_probe
+                if probe is not None:
+                    try:
+                        probe.observe(engine, feats_n, key_rows,
+                                      tail_feats=tail_feats,
+                                      tail_keys=tail_keys)
+                    except Exception:
+                        # the probe is observability, scoring is product:
+                        # a probe failure is logged, never raised into
+                        # the response path
+                        log.exception("copyrisk: recall probe failed")
             scores = [RiskScore(max_sim=float(row_sims[0]),
                                 top_key=str(row_keys[0]),
                                 topk=[(str(k), float(s))

@@ -1,0 +1,140 @@
+"""dcr-slo: sampled shadow-exact recall probe for the online ANN path.
+
+PR 19's recall number is a one-shot bench artifact (BENCH_ANN.json):
+true the day it was banked, silent the day the corpus drifts. This
+module turns recall into a *continuously observed* quantity with zero
+extra infrastructure — the same pattern as the SSCD fidelity gates,
+applied online:
+
+every Nth ANN scoring call, the probe re-runs the SAME queries through
+the SAME :class:`~dcr_tpu.search.annindex.AnnEngine` at full probe
+width (``nprobe = n_lists``). With every inverted list probed the
+candidate set is the whole committed corpus, and the engine's f32
+re-rank is already exact — so the full-probe answer IS the exact
+``search/topk`` oracle, bit-for-bit, with no second engine, no second
+compiled program, and no second copy of the store in memory. The live
+WAL tail (already scanned exactly by ``query_rows``) merges into both
+sides identically, so the probe measures exactly what production
+shortlists miss: candidates pruned by the IVF probe.
+
+Results feed a rolling window published as ``dcr_ann_recall_online_pct``
+(+ ``..._samples`` so consumers can weight it); the fleet scrape carries
+it to the supervisor, where the ``recall`` SLO objective judges it. The
+``recall_degrade`` fault kind corrupts the production shortlist the
+probe sees — driving the objective ok -> breach -> ok deterministically
+in tests without ever touching real traffic.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional, Sequence
+
+import numpy as np
+
+from dcr_tpu.core import tracing
+from dcr_tpu.utils import faults
+
+
+class RecallProbe:
+    """Rolling online recall@k, sampled once per ``every_n`` ANN calls.
+
+    Thread-safe: serve handler threads share one probe per risk index.
+    The expensive full-probe query runs OUTSIDE the lock — only the
+    sampling decision and the rolling-window update are serialized, so a
+    probe in flight never blocks the next scoring call's sampling check.
+    """
+
+    def __init__(self, *, every_n: int = 32, k: int = 10,
+                 window: int = 64):
+        if every_n < 1:
+            raise ValueError(f"every_n must be >= 1, got {every_n}")
+        if k < 1 or window < 1:
+            raise ValueError(f"k/window must be >= 1, got {k}/{window}")
+        self.every_n = int(every_n)
+        self.k = int(k)
+        self.window = int(window)
+        self._lock = threading.Lock()
+        self._calls = 0
+        self._probes = 0
+        self._recalls: deque = deque(maxlen=self.window)
+
+    # -- hot-path entry ------------------------------------------------------
+
+    def observe(self, engine, q: np.ndarray, ann_keys: np.ndarray, *,
+                tail_feats: Optional[np.ndarray] = None,
+                tail_keys: Optional[Sequence[str]] = None) -> Optional[float]:
+        """Called by the copy-risk scorer with the production shortlist it
+        just computed. Returns this sample's recall when this call was
+        probed, else None (not a probe tick). ``ann_keys`` is the [n, K]
+        key table the ANN path (including any tail merge) produced."""
+        with self._lock:
+            self._calls += 1
+            if (self._calls - 1) % self.every_n != 0:
+                return None
+            self._probes += 1
+            probe_idx = self._probes
+        if faults.fire("recall_degrade", probe=probe_idx):
+            # corrupt the shortlist the probe judges (production results
+            # are untouched): every key misses, recall pins to 0
+            ann_keys = np.full_like(np.asarray(ann_keys, dtype=object),
+                                    "__recall_degrade__")
+        truth_keys = self._oracle(engine, q, tail_feats, tail_keys)
+        recall = self._recall_at_k(ann_keys, truth_keys)
+        with self._lock:
+            self._recalls.append(recall)
+            rolling = sum(self._recalls) / len(self._recalls)
+            samples = len(self._recalls)
+        reg = tracing.registry()
+        reg.gauge("ann/recall_online_pct").set(int(round(rolling * 100)))
+        reg.gauge("ann/recall_online_samples").set(samples)
+        reg.counter("ann/recall_probe_total").inc()
+        tracing.event("ann/recall_probe", k=self.k,
+                      queries=int(np.asarray(q).shape[0]),
+                      recall=round(recall, 4), rolling=round(rolling, 4),
+                      samples=samples)
+        return recall
+
+    # -- internals -----------------------------------------------------------
+
+    @staticmethod
+    def _oracle(engine, q, tail_feats, tail_keys) -> np.ndarray:
+        """Exact top-k key table: full-probe IVF (candidate set = whole
+        committed corpus, re-rank already exact) merged with the exact
+        tail scan — the shadow oracle."""
+        e_scores, e_keys = engine.query(q, nprobe=engine.ann.n_lists)
+        if tail_feats is not None and len(tail_feats):
+            from dcr_tpu.search.shardindex import merge_topk
+
+            t_scores, t_keys = engine.query_rows(q, tail_feats, tail_keys)
+            _, e_keys = merge_topk(e_scores, e_keys, t_scores, t_keys)
+        return e_keys
+
+    def _recall_at_k(self, ann_keys: np.ndarray,
+                     truth_keys: np.ndarray) -> float:
+        """Same set-overlap recall as
+        :func:`dcr_tpu.search.annindex.spot_check_recall` — one
+        definition of recall across bench and online paths."""
+        ann_keys = np.asarray(ann_keys, dtype=object)
+        kk = min(self.k, ann_keys.shape[1], truth_keys.shape[1])
+        hits = total = 0
+        for arow, erow in zip(ann_keys, truth_keys):
+            truth = set(x for x in erow[:kk] if x)
+            if not truth:
+                continue
+            hits += len(truth & set(arow[:kk].tolist()))
+            total += len(truth)
+        return hits / total if total else 1.0
+
+    # -- introspection -------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            samples = len(self._recalls)
+            rolling = (sum(self._recalls) / samples) if samples else None
+            return {"calls": self._calls, "probes": self._probes,
+                    "samples": samples, "every_n": self.every_n,
+                    "k": self.k,
+                    "rolling_recall": (round(rolling, 4)
+                                       if rolling is not None else None)}

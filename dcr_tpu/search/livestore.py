@@ -64,6 +64,7 @@ import signal
 import struct
 import threading
 import time
+from collections import deque
 from io import BytesIO
 from pathlib import Path
 from typing import Callable, Optional, Sequence
@@ -243,6 +244,12 @@ class LiveStore:
         self._active_file = None
         self._append_count = 0
         self._compact_count = 0
+        # dcr-slo lag/growth bookkeeping: ack wall-time per unfolded seq
+        # (the WAL frame's ts is discarded on scan, and recovered rows get
+        # the recovery time — a conservative age reset across restarts)
+        # and a sliding window of (ts, rows) for the growth-rate gauge
+        self._seq_ts: dict[int, float] = {}
+        self._growth: deque = deque()
         self.committed_total = 0
         self.snapshot = 0
         self.recovered_rows = 0
@@ -355,7 +362,11 @@ class LiveStore:
             reg.counter("ingest/recovered_total").inc(rows)
         if torn:
             reg.counter("ingest/torn_total").inc(torn)
-        reg.gauge("store/rows_total").set(self.total_rows)
+        now = time.time()
+        for seq, _, _ in self._tail:
+            if seq > self._wal_through:
+                self._seq_ts[seq] = now
+        self._update_lag_gauges_locked()
         if rows or torn:
             tracing.event("ingest/recovered", rows=rows, torn=torn,
                           segments=segments, next_seq=self._next_seq)
@@ -466,9 +477,11 @@ class LiveStore:
                                                          dtype=object)))
             self._tail_rows += n
             self._active_rows += n
-            reg = tracing.registry()
-            reg.counter("ingest/acked_total").inc(n)
-            reg.gauge("store/rows_total").set(self.total_rows)
+            now = time.time()
+            self._seq_ts[seq] = now
+            self._growth.append((now, n))
+            tracing.registry().counter("ingest/acked_total").inc(n)
+            self._update_lag_gauges_locked()
             if self._active_rows >= self.seal_rows:
                 self._roll()
         return seq
@@ -568,9 +581,9 @@ class LiveStore:
                                 ms=round(1e3 * (time.monotonic() - t0), 3))
             tracing.event("ingest/compacted", rows=rows, records=len(folds),
                           snapshot=self.snapshot, wal_through=last_seq)
-            tracing.registry().gauge("store/rows_total").set(self.total_rows)
             if prune:
                 self._prune_locked(last_seq)
+            self._update_lag_gauges_locked()
             return {"folded_rows": rows, "records": len(folds),
                     "snapshot": self.snapshot, "wal_through": last_seq,
                     "manifest": str(manifest),
@@ -581,6 +594,41 @@ class LiveStore:
         kept = [(seq, f, k) for seq, f, k in self._tail if seq > through_seq]
         self._tail = kept
         self._tail_rows = sum(f.shape[0] for _, f, _ in kept)
+        self._seq_ts = {seq: ts for seq, ts in self._seq_ts.items()
+                        if seq > through_seq}
+
+    # -- dcr-slo lag/growth gauges -------------------------------------------
+
+    GROWTH_WINDOW_S = 60.0
+
+    def _update_lag_gauges_locked(self) -> None:
+        """Refresh the ingest-lag / store-growth / staleness gauges the SLO
+        plane scrapes. Caller holds ``_mu`` (or is single-threaded, as in
+        recovery). Cheap: O(tail records), no I/O."""
+        now = time.time()
+        while self._growth and self._growth[0][0] < now - self.GROWTH_WINDOW_S:
+            self._growth.popleft()
+        unfolded_ts = [ts for seq, ts in self._seq_ts.items()
+                       if seq > self._wal_through]
+        unfolded_rows = sum(f.shape[0] for seq, f, _ in self._tail
+                            if seq > self._wal_through)
+        reg = tracing.registry()
+        reg.gauge("store/rows_total").set(self.committed_total
+                                          + unfolded_rows)
+        reg.gauge("ingest/backlog_rows").set(unfolded_rows)
+        reg.gauge("ingest/lag_seqs").set(
+            max(0, self._next_seq - 1 - self._wal_through))
+        reg.gauge("ingest/oldest_unfolded_age_s").set(
+            round(now - min(unfolded_ts), 3) if unfolded_ts else 0.0)
+        reg.gauge("store/growth_rows_per_s").set(
+            round(sum(n for _, n in self._growth) / self.GROWTH_WINDOW_S, 4))
+
+    def update_lag_gauges(self) -> None:
+        """Public re-export hook: the ingest pump calls this on idle ticks
+        so the age gauge keeps aging (and the growth gauge keeps decaying)
+        between appends, not only when traffic moves."""
+        with self._mu:
+            self._update_lag_gauges_locked()
 
     def prune(self, through_seq: Optional[int] = None) -> None:
         """Drop folded rows from the in-memory tail once no reader needs

@@ -23,6 +23,7 @@ snapshot they started with and no row is ever served twice or missed.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -35,6 +36,7 @@ from dcr_tpu.core import tracing
 from dcr_tpu.search.livestore import DEFAULT_SEAL_ROWS, LiveStore
 from dcr_tpu.search.store import (DEFAULT_LEASE_S, StoreError,
                                   StoreLeaseHeldError)
+from dcr_tpu.utils import faults
 
 log = logging.getLogger("dcr_tpu")
 
@@ -152,8 +154,13 @@ class IngestPump:
                         break
                     reg.gauge("ingest/lag_seconds").set(0.0)
                     reg.gauge("ingest/queue_depth").set(0)
+                    # keep the age/growth gauges moving between appends —
+                    # a quiet pump with a stale unfolded row must still age
+                    live.update_lag_gauges()
                     continue
                 oldest_ts, feats, keys = self._drain_batch(first)
+                if faults.fire("ingest_stall", row=self.appended_rows):
+                    self._stall(reg, oldest_ts)
                 try:
                     live.append(feats, keys)
                     with self._stats_lock:
@@ -181,6 +188,28 @@ class IngestPump:
             with self._stats_lock:
                 if self.status == "ok":
                     self.status = "stopped"
+
+    def _stall(self, reg, oldest_ts: float) -> None:
+        """Injected ``ingest_stall`` fault: the pump stops acking for
+        ``DCR_INGEST_STALL_S`` seconds while the lag gauges keep reporting
+        the truth (that is the point — the SLO plane must SEE the stall).
+        Rows are delayed, never dropped: the batch appends after the stall,
+        so recovery is a clean breach -> ok round trip with zero loss."""
+        stall_s = float(os.environ.get("DCR_INGEST_STALL_S", "30"))
+        with self._stats_lock:
+            self.status = "stalled"
+        tracing.event("ingest/stall", stall_s=stall_s,
+                      row=self.appended_rows)
+        log.warning("ingest: injected stall for %.1fs at row %d",
+                    stall_s, self.appended_rows)
+        deadline = time.monotonic() + stall_s
+        while not self._stop.is_set() and time.monotonic() < deadline:
+            reg.gauge("ingest/lag_seconds").set(
+                max(0.0, time.time() - oldest_ts))
+            reg.gauge("ingest/queue_depth").set(self._q.qsize())
+            self._stop.wait(0.1)
+        with self._stats_lock:
+            self.status = "ok"
 
     def _compact(self, live: LiveStore) -> None:
         try:

@@ -22,6 +22,10 @@ Endpoints (JSON in/out):
   ``GET /metrics?format=prometheus`` renders the process-wide telemetry
   registry (core/tracing.py) — the same document plus ``faults/*`` counters
   and latency summaries — in Prometheus text exposition format for scrapes.
+- ``GET /slo`` — the fleet supervisor's declarative SLO document
+  (``obs/slo.py``): per-objective state (ok/warn/breach), burn rates,
+  targets and breach counters; 404 on a service without an SLO engine
+  (single-process worker).
 
 ``http.server`` is deliberate: zero new dependencies, and the threading
 server's one-thread-per-connection model matches the workload — handler
@@ -198,6 +202,15 @@ class ServeHandler(BaseHTTPRequestHandler):
                 self._reply_text(200, tracing.registry().prometheus_text())
             else:
                 self._reply(200, self.service.status())
+        elif url.path == "/slo":
+            slo_fn = getattr(self.service, "slo_doc", None)
+            if not callable(slo_fn):
+                self._reply(404, {"error": "slo engine not supported"})
+                return
+            try:
+                self._reply(200, slo_fn())
+            except Exception as e:
+                self._reply(500, {"error": f"slo status failed: {e!r}"})
         elif url.path == "/debug/profile":
             status_fn = getattr(self.service, "profile_status", None)
             if not callable(status_fn):

@@ -59,6 +59,7 @@ from dcr_tpu.core import tracing
 from dcr_tpu.core.config import ServeConfig, to_dict
 from dcr_tpu.core.coordination import EXIT_OOM
 from dcr_tpu.core.metrics import LatencyTracker
+from dcr_tpu.obs.slo import SloEngine, default_objectives, parse_exposition
 from dcr_tpu.serve.batcher import Batcher
 from dcr_tpu.serve.fleet import (FleetPaths, RequestJournal, WorkerLease,
                                  clear_lease, fleet_paths, read_lease)
@@ -358,6 +359,13 @@ class FleetSupervisor:
         self._scrape = ScrapeCache(cfg.host, cfg.fleet.scrape_timeout_s)
         self._scraper: Optional[threading.Thread] = None
         self._last_profile_worker: Optional[int] = None
+        # dcr-slo: the declarative SLO engine rides the monitor loop; the
+        # prev-counter snapshots turn lifetime counters into per-tick
+        # deltas (a single shed burst must not latch the rate forever)
+        self._slo = (SloEngine(cfg.slo, default_objectives(cfg))
+                     if cfg.slo.enabled else None)
+        self._slo_prev = {"accepted": 0.0, "shed": 0.0}
+        self._slo_scrape_prev: dict[int, dict[str, float]] = {}
 
     def counter(self, name: str):
         return tracing.registry().counter(f"fleet/{name}")
@@ -601,6 +609,14 @@ class FleetSupervisor:
                             self._spawn(slot)
             tracing.registry().gauge("fleet/workers_alive").set(float(alive))
             self._update_slo_gauges(alive)
+            if self._slo is not None:
+                try:
+                    self._slo.observe(self._slo_signals())
+                except Exception as e:
+                    # evaluation is observability; the monitor loop is the
+                    # fleet's heartbeat — log the failure, keep monitoring
+                    R.log_event("slo_observe_failed", error=repr(e))
+                    R.bump_counter("slo_observe_errors")
             with self._lock:
                 all_retired = all(s.state == RETIRED for s in self._slots)
             if alive == 0 and all_retired and not self._fatal.is_set():
@@ -622,6 +638,95 @@ class FleetSupervisor:
         reg.gauge("fleet/shed_rate").set(shed / max(1, accepted + shed))
         reg.gauge("fleet/requeue_rate").set(
             counts.get("fleet/requeued", 0) / max(1, accepted))
+
+    # -- dcr-slo: objective signals + engine access ---------------------------
+
+    def _fresh_worker_metrics(self) -> dict[int, dict[str, float]]:
+        """Parsed metric dicts for every ALIVE worker whose cached scrape is
+        FRESH (same staleness rule as ``dcr_fleet_worker_up``). A stale or
+        missing scrape excludes the worker entirely — the SLO plane judges
+        what it can still see, never a dead worker's last-good numbers."""
+        f = self.cfg.fleet
+        stale_after = (3 * max(f.scrape_period_s, f.scrape_timeout_s)
+                       + len(self._slots) * f.scrape_timeout_s)
+        scraped = self._scrape.snapshot()
+        with self._lock:
+            alive_idx = [s.index for s in self._slots if s.state == ALIVE]
+        out: dict[int, dict[str, float]] = {}
+        for index in alive_idx:
+            text_age = scraped.get(index)
+            if text_age is not None and text_age[1] <= stale_after:
+                out[index] = parse_exposition(text_age[0])
+        return out
+
+    def _slo_signals(self) -> dict:
+        """One signal snapshot per monitor tick for :meth:`SloEngine.observe`.
+        Rates come from per-tick counter DELTAS (lifetime ratios latch old
+        incidents forever); absent planes report None (no sample), never a
+        fake healthy value."""
+        workers = self._fresh_worker_metrics()
+        signals: dict = {
+            "availability": len(workers) / max(1, len(self._slots)),
+            "queue_wait_p99_s":
+                self.metrics.queue_wait.percentiles((99,))["p99"],
+        }
+        counts = tracing.registry().counters("fleet/")
+        accepted = float(counts.get("fleet/accepted", 0))
+        shed = float(counts.get("fleet/shed", 0))
+        d_acc = accepted - self._slo_prev["accepted"]
+        d_shed = shed - self._slo_prev["shed"]
+        self._slo_prev.update(accepted=accepted, shed=shed)
+        signals["shed_rate"] = (d_shed / (d_acc + d_shed)
+                                if (d_acc + d_shed) > 0 else None)
+        lag = [max(m.get("dcr_ingest_lag_seconds", 0.0),
+                   m.get("dcr_ingest_oldest_unfolded_age_s", 0.0))
+               for m in workers.values()
+               if "dcr_ingest_lag_seconds" in m
+               or "dcr_ingest_oldest_unfolded_age_s" in m]
+        signals["ingest_lag_s"] = max(lag) if lag else None
+        stale = [m["dcr_ann_staleness_rows"] for m in workers.values()
+                 if "dcr_ann_staleness_rows" in m]
+        signals["ann_staleness_rows"] = max(stale) if stale else None
+        # online recall: sample-weighted across workers — a worker with 64
+        # probed samples outweighs one that has probed twice
+        num = den = 0.0
+        for m in workers.values():
+            n = m.get("dcr_ann_recall_online_samples", 0.0)
+            if n > 0 and "dcr_ann_recall_online_pct" in m:
+                num += (m["dcr_ann_recall_online_pct"] / 100.0) * n
+                den += n
+        signals["recall"] = (num / den) if den > 0 else None
+        # coverage: scored/completed per tick, summed across workers; a
+        # counter that moved backwards is a restarted worker — clamp its
+        # delta to the fresh lifetime value instead of going negative
+        d_scored = d_done = 0.0
+        for index, m in workers.items():
+            prev = self._slo_scrape_prev.get(index, {})
+            for key, bucket in (("dcr_copy_risk_scored_total", "scored"),
+                                ("dcr_serve_completed_total", "done")):
+                cur = m.get(key)
+                if cur is None:
+                    continue
+                delta = cur - prev.get(key, 0.0)
+                if delta < 0:
+                    delta = cur
+                if bucket == "scored":
+                    d_scored += delta
+                else:
+                    d_done += delta
+            self._slo_scrape_prev[index] = {
+                k: m[k] for k in ("dcr_copy_risk_scored_total",
+                                  "dcr_serve_completed_total") if k in m}
+        signals["coverage"] = (min(1.0, d_scored / d_done)
+                               if d_done > 0 else None)
+        return signals
+
+    def slo_doc(self) -> dict:
+        """``GET /slo``: the engine's full objective document (also the
+        ``dcr-status`` payload)."""
+        if self._slo is None:
+            return {"enabled": False}
+        return self._slo.doc()
 
     # -- fleet metrics aggregation -------------------------------------------
 

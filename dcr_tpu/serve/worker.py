@@ -746,6 +746,15 @@ class GenerationService:
             ev_dir = str(base / "risk_evidence") if base is not None else ""
         self._evidence = EvidenceRecorder(ev_dir or None,
                                           cfg.risk.max_evidence)
+        if cfg.risk.ann and cfg.slo.enabled:
+            # dcr-slo: sampled shadow-exact recall probe rides the ANN
+            # scoring path — the full-probe query is its own exact oracle
+            from dcr_tpu.obs.recall_probe import RecallProbe
+
+            index.recall_probe = RecallProbe(
+                every_n=cfg.slo.recall_probe_every_n,
+                k=cfg.slo.recall_probe_k,
+                window=cfg.slo.recall_probe_window)
         self._risk = index
         self._risk_status = "ok"
         self._risk_done.set()

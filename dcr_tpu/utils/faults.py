@@ -110,6 +110,20 @@ coordinated-recovery tests. Supported kinds and their hook points:
   (``ann/kmeans_restart``) and a run that exhausts its restarts raises a
   typed ``AnnError`` instead of committing NaN centroids.
   ``kmeans_nan@iter=1`` poisons the second iteration.
+- ``ingest_stall`` — live-ingest pump (serve/ingest.py), coord ``row``
+  (rows appended so far): the appender stops acking for
+  ``DCR_INGEST_STALL_S`` seconds (default 30) while the lag gauges keep
+  reporting the growing backlog — rows are delayed, never dropped, so the
+  drill proves the ``ingest_lag_s`` SLO objective walks ok -> breach ->
+  ok with zero loss. ``ingest_stall@row=0`` stalls before the first
+  append.
+- ``recall_degrade`` — online recall probe (obs/recall_probe.py), coord
+  ``probe`` (1-based probe index): corrupts the production shortlist THE
+  PROBE JUDGES (real responses untouched), pinning that sample's recall
+  to 0 — the deterministic way to drive the ``recall`` SLO objective into
+  breach and back. ``recall_degrade@probe=2`` poisons the second probe;
+  ``recall_degrade@rank=0x8`` poisons eight consecutive probes on fleet
+  worker 0.
 
 In a serving fleet the ``rank`` coordinate maps to the WORKER INDEX: the
 supervisor exports ``DCR_WORKER_INDEX`` into each worker's environment and

@@ -1,0 +1,875 @@
+"""dcr-slo tests: declarative SLO engine + continuous quality observability.
+
+Fast tier (no model, no subprocess): the multi-window burn-rate state
+machine (breach needs BOTH windows, a lone spike cannot breach, warn
+hysteresis, recovery, sustained-breach flight-recorder dump), exposition
+parsing tolerance, objective derivation from config (absent planes produce
+absent objectives), the supervisor's signal snapshot over the scrape cache
+(a stale scrape drives availability DOWN; shed/coverage come from per-tick
+deltas so one burst can never latch; a restarted worker's
+backwards-moving counter clamps instead of going negative), the online
+recall probe vs the exact oracle (the ±0.05 acceptance) plus its
+``recall_degrade`` drill, the dcr-live lag gauges draining to ~0 after
+compaction, the ``ingest_stall`` drill (rows delayed, never dropped),
+``GET /slo`` and the stdlib ``dcr-status`` CLI against a stub fleet (exit
+codes 0/1/2), tools/bench_report over the banked artifacts, and the
+trace_report SLO-timeline + sample-weighted recall sections.
+
+Slow tier (CI `slo` job): the acceptance e2e — a real 2-worker fleet with
+an injected ``worker_crash`` walks availability ok -> breach -> ok on
+``GET /slo`` with zero dropped requests and ``slo/breach``/``slo/recover``
+events in the fleet trace; and a real IngestPump under ``ingest_stall``
+drives the ``ingest_lag_s`` objective through the same round trip via the
+supervisor's own signal plumbing, recovering to ~0 lag after compaction.
+"""
+
+import json
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from dcr_tpu.cli import status as cli_status
+from dcr_tpu.core import tracing
+from dcr_tpu.core.config import (FleetConfig, IngestConfig, RiskConfig,
+                                 ServeConfig, SloConfig)
+from dcr_tpu.obs.recall_probe import RecallProbe
+from dcr_tpu.obs.slo import (BREACH, OK, WARN, SloEngine, SloObjective,
+                             default_objectives, parse_exposition)
+from dcr_tpu.utils import faults
+from tools import bench_report, trace_report
+
+DIM = 16
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.reset_for_tests()
+    yield
+    tracing.reset_for_tests()
+
+
+def _cfg(**kw) -> SloConfig:
+    """Tight windows + budget 0.5 (all-bad burn = 2.0 = breach_burn), no
+    flight-recorder dump unless a test asks for one."""
+    base = dict(short_window_s=10.0, long_window_s=30.0, warn_burn=1.0,
+                breach_burn=2.0, recover_burn=0.5, budget=0.5,
+                dump_after_s=-1.0)
+    base.update(kw)
+    return SloConfig(**base)
+
+
+def _avail_engine(cfg=None) -> SloEngine:
+    return SloEngine(cfg or _cfg(), [SloObjective(
+        "availability", "availability", "min", 0.9, "alive fraction")])
+
+
+def _gauge(name: str) -> float:
+    return tracing.registry().gauge(name).value
+
+
+def _counter(name: str) -> float:
+    return tracing.registry().counter(name).value
+
+
+# ---------------------------------------------------------------------------
+# 1. the burn-rate state machine
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_breach_needs_both_windows_then_recovers():
+    eng = _avail_engine()
+    t0 = 1000.0
+    for i in range(30):                       # healthy history
+        eng.observe({"availability": 1.0}, now=t0 + i)
+    doc = eng.doc()
+    assert doc["state"] == OK
+    assert doc["objectives"]["availability"]["burn_short"] == 0.0
+
+    seen = []
+    for i in range(41):                       # sustained outage
+        eng.observe({"availability": 0.5}, now=t0 + 30 + i)
+        seen.append(eng.doc()["objectives"]["availability"]["state"])
+    # the short window saturates first (warn), the long window only after
+    # the healthy history ages out — warn strictly precedes breach
+    assert WARN in seen and BREACH in seen
+    assert seen.index(WARN) < seen.index(BREACH)
+    assert seen[-1] == BREACH and eng.breached()
+    assert _counter("slo/breach_total") == 1
+    assert _counter("slo/breach_total/availability") == 1
+    assert _gauge("slo/state/availability") == 2
+    obj = eng.doc()["objectives"]["availability"]
+    assert obj["breach_total"] == 1 and obj["value"] == 0.5
+    assert obj["breach_for_s"] > 0
+
+    for i in range(15):                       # recovery
+        eng.observe({"availability": 1.0}, now=t0 + 72 + i)
+    doc = eng.doc()
+    assert doc["state"] == OK and not eng.breached()
+    assert doc["objectives"]["availability"]["breach_for_s"] == 0.0
+    assert doc["objectives"]["availability"]["breach_total"] == 1
+    assert _counter("slo/breach_total") == 1   # latched history, not state
+    assert _gauge("slo/state/availability") == 0
+
+
+@pytest.mark.fast
+def test_short_spike_warns_but_cannot_breach():
+    eng = _avail_engine()
+    t0 = 2000.0
+    for i in range(30):
+        eng.observe({"availability": 1.0}, now=t0 + i)
+    states = set()
+    for i in range(12):                       # 12s spike < long window
+        eng.observe({"availability": 0.0}, now=t0 + 30 + i)
+        states.add(eng.doc()["objectives"]["availability"]["state"])
+    assert states == {OK, WARN}               # the long window vetoed it
+    assert _counter("slo/breach_total") == 0
+    for i in range(15):                       # hysteresis: warn -> ok
+        eng.observe({"availability": 1.0}, now=t0 + 42 + i)
+    assert eng.doc()["objectives"]["availability"]["state"] == OK
+
+
+@pytest.mark.fast
+def test_none_signal_drains_window_instead_of_latching():
+    """Satellite 5b at the engine level: after a shed burst the signal goes
+    None (no traffic). The verdict must decay by time, not latch."""
+    eng = SloEngine(_cfg(), [SloObjective(
+        "shed_rate", "shed_rate", "max", 0.05, "")])
+    t0 = 3000.0
+    for i in range(35):                       # burst long enough to breach
+        eng.observe({"shed_rate": 0.5}, now=t0 + i)
+    assert eng.doc()["objectives"]["shed_rate"]["state"] == BREACH
+    for i in range(40):                       # silence: only time passes
+        eng.observe({"shed_rate": None}, now=t0 + 35 + i)
+    obj = eng.doc()["objectives"]["shed_rate"]
+    assert obj["state"] == OK
+    assert obj["samples"] == 0                # the burst fully aged out
+
+
+@pytest.mark.fast
+def test_sustained_breach_dumps_flight_recorder(tmp_path, monkeypatch):
+    monkeypatch.delenv("DCR_WORKER_INDEX", raising=False)
+    tracing.configure(tmp_path, rank=0)
+    eng = _avail_engine(_cfg(dump_after_s=5.0))
+    t0 = 4000.0
+    for i in range(8):                        # all-bad: breach on tick 0
+        eng.observe({"availability": 0.0}, now=t0 + i)
+    dump = tmp_path / "flightrec_0.json"
+    assert dump.exists()
+    doc = json.loads(dump.read_text())
+    assert doc["reason"] == "slo_breach_sustained: availability"
+    # the extra= forensic section carries the full objective document
+    assert doc["slo"]["objectives"]["availability"]["state"] == BREACH
+    # transitions are trace events, not just log lines
+    trace = (tmp_path / "trace.jsonl").read_text()
+    assert '"slo/breach"' in trace
+
+
+@pytest.mark.fast
+def test_parse_exposition_skips_comments_labels_and_garbage():
+    text = ("# HELP dcr_up h\n"
+            "# TYPE dcr_up gauge\n"
+            "dcr_up 1\n"
+            "\n"
+            'dcr_latency{quantile="0.99"} 0.5\n'
+            "dcr_bad not-a-float\n"
+            "dcr_ingest_lag_seconds 2.25\n")
+    assert parse_exposition(text) == {"dcr_up": 1.0,
+                                      "dcr_ingest_lag_seconds": 2.25}
+
+
+@pytest.mark.fast
+def test_default_objectives_follow_configured_planes():
+    base = dict(resolution=16, num_inference_steps=2, sampler="ddim")
+    names = {o.name for o in default_objectives(ServeConfig(**base))}
+    # no ingest, no risk index, shedding disabled (target 0): only the
+    # always-on fleet objectives exist
+    assert names == {"availability", "shed_rate"}
+
+    full = ServeConfig(**base,
+                       fleet=FleetConfig(slo_queue_wait_p99_s=2.0),
+                       ingest=IngestConfig(enabled=True),
+                       risk=RiskConfig(store_dir="/s", ann=True))
+    names = {o.name for o in default_objectives(full)}
+    assert names == {"availability", "queue_wait_p99_s", "shed_rate",
+                     "ingest_lag_s", "ann_staleness_rows", "recall",
+                     "coverage"}
+
+    off = ServeConfig(**base, slo=SloConfig(availability_min=0.0))
+    assert "availability" not in {o.name for o in default_objectives(off)}
+
+    with pytest.raises(ValueError):
+        SloObjective("x", "x", "between", 1.0)
+    with pytest.raises(ValueError):
+        SloEngine(_cfg(), [SloObjective("a", "a", "min", 1.0),
+                           SloObjective("a", "b", "max", 1.0)])
+
+
+# ---------------------------------------------------------------------------
+# 2. supervisor signal snapshot over the scrape cache (satellite 5)
+# ---------------------------------------------------------------------------
+
+_WORKER0_TEXT = ("# HELP h h\n# TYPE h gauge\n"
+                 "dcr_ingest_lag_seconds 2.5\n"
+                 "dcr_ingest_oldest_unfolded_age_s 7.5\n"
+                 "dcr_ann_staleness_rows 1200\n"
+                 "dcr_ann_recall_online_pct 90\n"
+                 "dcr_ann_recall_online_samples 30\n"
+                 "dcr_copy_risk_scored_total 5\n"
+                 "dcr_serve_completed_total 10\n")
+_WORKER1_TEXT = ("dcr_ingest_lag_seconds 40\n"
+                 "dcr_ingest_oldest_unfolded_age_s 1\n"
+                 "dcr_ann_staleness_rows 300\n"
+                 "dcr_ann_recall_online_pct 50\n"
+                 "dcr_ann_recall_online_samples 10\n")
+
+
+def _supervisor(tmp_path, workers=2):
+    from dcr_tpu.serve.supervisor import ALIVE, FleetSupervisor
+
+    cfg = ServeConfig(resolution=16, num_inference_steps=2, sampler="ddim",
+                      fleet=FleetConfig(workers=workers, dir=str(tmp_path)))
+    sup = FleetSupervisor(cfg)                # never started: no subprocesses
+    for slot in sup._slots:
+        slot.state = ALIVE
+    return sup
+
+
+@pytest.mark.fast
+def test_stale_scrape_drives_availability_down(tmp_path):
+    """Satellite 5a: an ALIVE slot whose scrape went stale must count as
+    unavailable — the SLO plane judges what it can still see, never a dead
+    worker's last-good numbers."""
+    sup = _supervisor(tmp_path)
+    try:
+        now = time.time()
+        sup._scrape._cache = {0: (_WORKER0_TEXT, now),
+                              1: (_WORKER1_TEXT, now)}
+        sig = sup._slo_signals()
+        assert sig["availability"] == 1.0
+        assert sig["ingest_lag_s"] == 40.0            # worst worker wins
+        assert sig["ann_staleness_rows"] == 1200.0
+        # sample-weighted online recall: (0.9*30 + 0.5*10) / 40
+        assert abs(sig["recall"] - 0.8) < 1e-9
+        assert sig["shed_rate"] is None               # no traffic this tick
+
+        # worker 1's scrape ages an hour: availability halves and its
+        # last-good lag/recall numbers stop contributing entirely
+        sup._scrape._cache = {0: (_WORKER0_TEXT, now),
+                              1: (_WORKER1_TEXT, now - 3600.0)}
+        sig = sup._slo_signals()
+        assert sig["availability"] == 0.5
+        assert sig["ingest_lag_s"] == 7.5             # worker 0's max only
+        assert sig["ann_staleness_rows"] == 1200.0
+        assert abs(sig["recall"] - 0.9) < 1e-9
+
+        # a never-scraped ALIVE slot is just as invisible
+        sup._scrape._cache = {0: (_WORKER0_TEXT, now)}
+        assert sup._slo_signals()["availability"] == 0.5
+    finally:
+        sup.journal.close()
+
+
+@pytest.mark.fast
+def test_shed_rate_is_per_tick_delta_not_lifetime(tmp_path):
+    """Satellite 5b at the supervisor level: one shed burst must read as one
+    bad tick, then None — a lifetime ratio would latch the breach forever."""
+    sup = _supervisor(tmp_path, workers=1)
+    try:
+        sup._scrape._cache = {0: (_WORKER0_TEXT, time.time())}
+        reg = tracing.registry()
+        reg.counter("fleet/accepted").inc(8)
+        reg.counter("fleet/shed").inc(2)
+        assert abs(sup._slo_signals()["shed_rate"] - 0.2) < 1e-9
+        # no new traffic: no sample, NOT the stale 0.2 again
+        assert sup._slo_signals()["shed_rate"] is None
+        reg.counter("fleet/accepted").inc(4)
+        assert sup._slo_signals()["shed_rate"] == 0.0
+    finally:
+        sup.journal.close()
+
+
+@pytest.mark.fast
+def test_coverage_delta_clamps_on_worker_restart(tmp_path):
+    sup = _supervisor(tmp_path, workers=1)
+    try:
+        sup._scrape._cache = {0: (_WORKER0_TEXT, time.time())}
+        assert abs(sup._slo_signals()["coverage"] - 0.5) < 1e-9  # 5/10
+        # restarted worker: counters moved BACKWARDS — the delta clamps to
+        # the fresh lifetime value instead of going negative
+        restarted = ("dcr_copy_risk_scored_total 2\n"
+                     "dcr_serve_completed_total 3\n")
+        sup._scrape._cache = {0: (restarted, time.time())}
+        assert abs(sup._slo_signals()["coverage"] - 2.0 / 3.0) < 1e-9
+        # idle tick: completed didn't move, no sample
+        assert sup._slo_signals()["coverage"] is None
+    finally:
+        sup.journal.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. online recall probe vs the exact oracle (the ±0.05 acceptance)
+# ---------------------------------------------------------------------------
+
+def _ann_setup(tmp_path, rng_np, rows=256):
+    from dcr_tpu.search import ann
+    from dcr_tpu.search.annindex import open_ann_engine
+    from dcr_tpu.search.shardindex import open_engine
+    from dcr_tpu.search.store import EmbeddingStoreWriter
+
+    centers = rng_np.standard_normal((8, DIM)).astype(np.float32) * 4.0
+    assign = rng_np.integers(0, 8, rows)
+    feats = (centers[assign]
+             + rng_np.standard_normal((rows, DIM)).astype(np.float32) * 0.1)
+    store = tmp_path / "store"
+    w = EmbeddingStoreWriter(store, embed_dim=DIM, shard_rows=64)
+    w.add(feats, [f"r{i}" for i in range(rows)])
+    w.finalize()
+    ann.train_ivf(store, n_lists=8, iters=5, seed=0)
+    eng = open_ann_engine(store, top_k=10, nprobe=2, query_batch=16)
+    exact = open_engine(store, top_k=10, query_batch=16)
+    q = (centers[rng_np.integers(0, 8, 12)]
+         + rng_np.standard_normal((12, DIM)).astype(np.float32) * 0.1)
+    return eng, exact, q
+
+
+def test_online_recall_matches_exact_oracle_within_tolerance(
+        tmp_path, rng_np):
+    from dcr_tpu.search.annindex import spot_check_recall
+
+    eng, exact, q = _ann_setup(tmp_path, rng_np)
+    _, ann_keys = eng.query(q)                # the production shortlist
+    probe = RecallProbe(every_n=1, k=10, window=8)
+    online = probe.observe(eng, q, ann_keys)
+    offline = spot_check_recall(eng, exact, q, k=10)
+    assert online is not None
+    # same shortlist, same recall definition, shadow-exact oracle: the
+    # online gauge must track the bench number (ISSUE acceptance: ±0.05)
+    assert abs(online - offline) <= 0.05
+    assert _gauge("ann/recall_online_pct") == int(round(online * 100))
+    assert _gauge("ann/recall_online_samples") == 1
+    assert _counter("ann/recall_probe_total") == 1
+    stats = probe.stats()
+    assert stats["probes"] == 1 and stats["rolling_recall"] is not None
+
+
+def test_recall_probe_samples_every_nth_and_degrade_drill(tmp_path, rng_np):
+    eng, _, q = _ann_setup(tmp_path, rng_np, rows=96)
+    _, ann_keys = eng.query(q)
+    probe = RecallProbe(every_n=4, k=10, window=8)
+    results = [probe.observe(eng, q, ann_keys) for _ in range(8)]
+    # calls 1 and 5 probe; the rest are free
+    assert [r is not None for r in results] == [True, False, False, False,
+                                                True, False, False, False]
+    assert probe.stats()["probes"] == 2
+    rolling_before = probe.stats()["rolling_recall"]
+    try:
+        faults.install("recall_degrade@probe=3")
+        degraded = probe.observe(eng, q, ann_keys)     # call 9 = probe 3
+    finally:
+        faults.clear()
+    assert degraded == 0.0                    # every corrupted key misses
+    assert probe.stats()["rolling_recall"] < rolling_before
+    with pytest.raises(ValueError):
+        RecallProbe(every_n=0)
+
+
+# ---------------------------------------------------------------------------
+# 4. dcr-live lag gauges + the ingest_stall drill
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_livestore_lag_gauges_drain_to_zero_after_compact(tmp_path, rng_np):
+    from dcr_tpu.search.livestore import LiveStore
+
+    feats = rng_np.standard_normal((8, DIM)).astype(np.float32)
+    with LiveStore.open(tmp_path / "s", embed_dim=DIM) as live:
+        live.append(feats, [f"k{i}" for i in range(8)])
+        live.update_lag_gauges()
+        assert _gauge("ingest/backlog_rows") == 8
+        assert _gauge("store/rows_total") == 8
+        assert _gauge("ingest/lag_seqs") >= 1
+        assert _gauge("ingest/oldest_unfolded_age_s") >= 0.0
+        assert _gauge("store/growth_rows_per_s") > 0.0
+        live.compact()
+        # the acceptance pin: lag returns to ~0 once the WAL folds
+        assert _gauge("ingest/backlog_rows") == 0
+        assert _gauge("ingest/lag_seqs") == 0
+        assert _gauge("ingest/oldest_unfolded_age_s") == 0.0
+        assert _gauge("store/rows_total") == 8
+
+
+def test_ingest_stall_delays_but_never_drops(tmp_path, rng_np, monkeypatch):
+    from dcr_tpu.serve.ingest import IngestPump
+
+    monkeypatch.setenv("DCR_INGEST_STALL_S", "0.6")
+    row = rng_np.standard_normal(DIM).astype(np.float32)
+    try:
+        faults.install("ingest_stall@row=0")
+        with IngestPump(tmp_path / "s", embed_dim=DIM, queue_max=8,
+                        batch_rows=1) as pump:
+            assert pump.offer(row, "k0") is True
+            deadline = time.monotonic() + 10
+            saw_stall = False
+            while time.monotonic() < deadline:
+                if pump.status == "stalled":
+                    saw_stall = True
+                if pump.stats()["appended_rows"] == 1:
+                    break
+                time.sleep(0.05)
+            stats = pump.stats()
+        assert saw_stall, "the stall fault never fired"
+        assert stats["appended_rows"] == 1    # delayed, NOT dropped
+        assert stats["dropped_rows"] == 0
+        assert stats["status"] in ("ok", "stopped")
+    finally:
+        faults.clear()
+
+
+@pytest.mark.fast
+def test_faults_docstring_documents_slo_drills():
+    for kind in ("ingest_stall", "recall_degrade"):
+        assert f"``{kind}``" in faults.__doc__, kind
+
+
+# ---------------------------------------------------------------------------
+# 5. GET /slo + the dcr-status CLI (stub fleet, exit codes)
+# ---------------------------------------------------------------------------
+
+_STUB_SLO_DOC = {
+    "enabled": True, "state": "breach", "breach_total": 2,
+    "windows_s": [60.0, 300.0],
+    "objectives": {
+        "availability": {"state": "breach", "kind": "min", "target": 0.75,
+                         "value": 0.5, "burn_short": 5.0, "burn_long": 2.1,
+                         "samples": 40, "breach_total": 2,
+                         "breach_for_s": 12.0, "description": ""},
+        "shed_rate": {"state": "ok", "kind": "max", "target": 0.05,
+                      "value": 0.0, "burn_short": 0.0, "burn_long": 0.0,
+                      "samples": 40, "breach_total": 0, "breach_for_s": 0.0,
+                      "description": ""}}}
+
+_STUB_PROM = ("# HELP dcr_ingest_lag_seconds h\n"
+              "# TYPE dcr_ingest_lag_seconds gauge\n"
+              'dcr_ingest_lag_seconds{worker="0"} 2.5\n'
+              'dcr_ingest_lag_seconds{worker="1"} 40\n'
+              'dcr_ingest_backlog_rows{worker="0"} 4\n'
+              'dcr_ingest_backlog_rows{worker="1"} 8\n'
+              'dcr_ann_staleness_rows{worker="0"} 1200\n'
+              'dcr_ann_recall_online_pct{worker="0"} 90\n'
+              'dcr_ann_recall_online_samples{worker="0"} 30\n'
+              'dcr_ann_recall_online_pct{worker="1"} 50\n'
+              'dcr_ann_recall_online_samples{worker="1"} 10\n')
+
+
+class _StubFleetService:
+    draining = False
+
+    def health(self):
+        return "ok"
+
+    def status(self):
+        return {"workers_alive": 2, "queue_depth": 0,
+                "workers": [{"index": 0, "state": "ALIVE", "failures": 0},
+                            {"index": 1, "state": "ALIVE", "failures": 1}],
+                "journal": {"pending": 0, "acked": 8}}
+
+    def prometheus_merged(self):
+        return _STUB_PROM
+
+    def slo_doc(self):
+        return dict(_STUB_SLO_DOC)
+
+
+def _serve_stub(service):
+    from dcr_tpu.serve.server import make_server
+
+    cfg = ServeConfig(resolution=16, num_inference_steps=2, sampler="ddim",
+                      port=0)
+    httpd = make_server(cfg, service)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, httpd.server_address[1]
+
+
+@pytest.mark.fast
+def test_slo_endpoint_serves_doc_and_404_without_engine():
+    httpd, port = _serve_stub(_StubFleetService())
+    try:
+        doc = cli_status.get_json("127.0.0.1", port, "/slo", 5.0)
+        assert doc["_http_status"] == 200
+        assert doc["enabled"] is True and doc["state"] == "breach"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    class _NoSlo:                             # pre-dcr-slo service shape
+        draining = False
+
+        def status(self):
+            return {}
+
+    httpd, port = _serve_stub(_NoSlo())
+    try:
+        doc = cli_status.get_json("127.0.0.1", port, "/slo", 5.0)
+        assert doc["_http_status"] == 404
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+@pytest.mark.fast
+def test_dcr_status_collect_aggregate_and_exit_codes(capsys):
+    httpd, port = _serve_stub(_StubFleetService())
+    try:
+        doc = cli_status.collect("127.0.0.1", port, 5.0)
+        assert doc["reachable"] and doc["workers_alive"] == 2
+        live = doc["live"]
+        assert live["ingest_lag_seconds"] == 40.0       # worst worker
+        assert live["ingest_backlog_rows"] == 12.0      # summed
+        assert live["ann_staleness_rows"] == 1200.0
+        assert live["recall_online_pct"] == 80.0        # sample-weighted
+        assert live["recall_online_samples"] == 40
+        assert cli_status.exit_code(doc) == 1           # SLO breach
+        text = cli_status.render_human(doc)
+        assert "BREACH" in text and "availability" in text
+        assert "online_recall=80.0%" in text
+        with pytest.raises(SystemExit) as e:
+            cli_status.main([f"--port={port}", "--json"])
+        assert e.value.code == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["slo"]["state"] == "breach"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+    # health "failed" alone is exit 1; SLO disabled stays informational
+    assert cli_status.exit_code({"reachable": True,
+                                 "health": {"status": "failed"},
+                                 "slo": {"enabled": False}}) == 1
+    assert cli_status.exit_code({"reachable": True,
+                                 "health": {"status": "ok"},
+                                 "slo": {"enabled": False}}) == 0
+
+    # unreachable front end: typed exit 2, never a traceback
+    from tests._multiproc import free_port
+
+    with pytest.raises(SystemExit) as e:
+        cli_status.main([f"--port={free_port()}", "--timeout=1", "--json"])
+    assert e.value.code == 2
+    assert json.loads(capsys.readouterr().out)["reachable"] is False
+
+
+# ---------------------------------------------------------------------------
+# 6. tools: bench_report, trace_report SLO sections, schema pins
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fast
+def test_bench_report_banked_artifacts_all_pass(capsys):
+    rows, errors = bench_report.collect_rows(REPO)
+    assert errors == []
+    assert len(rows) >= 14                    # every banked artifact surfaced
+    assert not any(r.get("passed") is False for r in rows)
+    assert bench_report.main(["--dir", str(REPO), "--format=github"]) == 0
+    out = capsys.readouterr().out
+    assert "| artifact | gate |" in out and " FAIL " not in out
+
+
+@pytest.mark.fast
+def test_bench_report_fails_on_unknown_artifact(tmp_path, capsys):
+    (tmp_path / "BENCH_MYSTERY.json").write_text("{}")
+    rows, errors = bench_report.collect_rows(tmp_path)
+    assert errors and "BENCH_MYSTERY.json" in errors[0]
+    assert bench_report.main(["--dir", str(tmp_path)]) == 1
+    assert bench_report.main(["--dir", str(tmp_path / "empty")]) == 1
+    capsys.readouterr()
+
+
+def _evt(name, ts, ident, **args):
+    return {"ph": "i", "name": name, "id": ident, "ts": ts, "pid": 1,
+            "tid": 1, "tname": "t", "args": args}
+
+
+@pytest.mark.fast
+def test_trace_report_slo_breach_timeline():
+    records = [
+        _evt("slo/breach", 2e6, 1, objective="availability", value=0.5,
+             target=0.9, kind="min", burn_short=2.0, burn_long=2.1),
+        _evt("slo/recover", 8e6, 2, objective="availability", value=1.0,
+             target=0.9, breach_s=6.0, burn_short=0.2),
+        _evt("slo/breach", 9e6, 3, objective="recall", value=0.6,
+             target=0.8, kind="min", burn_short=3.0, burn_long=2.5),
+    ]
+    slo = trace_report.slo_summary(records)
+    assert slo["objectives"] == {
+        "availability": {"breaches": 1, "recoveries": 1},
+        "recall": {"breaches": 1, "recoveries": 0}}
+    assert slo["open_breaches"] == ["recall"]
+    assert [t["event"] for t in slo["timeline"]] == ["breach", "recover",
+                                                     "breach"]
+    assert slo["timeline"][1]["breach_s"] == 6.0
+    text = trace_report.render_text(trace_report.summarize(records),
+                                    [Path(".")])
+    assert "SLO:" in text and "BREACH" in text
+    assert "still in breach at end of trace: recall" in text
+    # no slo events -> no section, other traces keep their shape
+    assert trace_report.slo_summary([_evt("risk/flagged", 1e6, 9)]) is None
+
+
+@pytest.mark.fast
+def test_trace_report_recall_is_sample_weighted():
+    span = {"ph": "X", "name": "search/kmeans", "id": 1, "ts": 1e6,
+            "dur": 1000.0, "pid": 1, "tid": 1, "tname": "t",
+            "args": {"iter": 0}}
+    records = [
+        span,
+        _evt("ann/recall_spot_check", 2e6, 2, k=10, queries=1, recall=1.0),
+        _evt("ann/recall_spot_check", 3e6, 3, k=10, queries=99, recall=0.5),
+        _evt("ann/recall_probe", 4e6, 4, k=10, queries=10, recall=0.9,
+             rolling=0.95, samples=1),
+        _evt("ann/recall_probe", 5e6, 5, k=10, queries=10, recall=0.5,
+             rolling=0.7, samples=2),
+    ]
+    out = trace_report.ann_summary(records)
+    # a 99-query check outweighs a 1-query one: (1*1 + 0.5*99) / 100
+    assert out["recall_spot_checks"]["mean_recall"] == 0.505
+    assert out["recall_spot_checks"]["samples"] == 100
+    assert out["recall_online"]["mean_recall"] == 0.7
+    assert out["recall_online"]["last_rolling"] == 0.7
+    assert out["recall_online"]["probes"] == 2
+    text = trace_report.render_text(trace_report.summarize(records),
+                                    [Path(".")])
+    assert "sample-weighted mean" in text
+    assert "online recall (shadow-oracle probes)" in text
+
+
+@pytest.mark.fast
+def test_trace_schema_and_metric_names_pin_slo_surface():
+    schema = json.loads((REPO / "tools" / "trace_schema.json").read_text())
+    assert "slo/*" in schema["known_names"]["events"]
+    assert "ann/*" in schema["known_names"]["events"]
+    for raw, want in (
+            ("slo/burn_rate/availability", "dcr_slo_burn_rate_availability"),
+            ("slo/state/availability", "dcr_slo_state_availability"),
+            ("slo/breach_total", "dcr_slo_breach_total"),
+            ("ann/recall_online_pct", "dcr_ann_recall_online_pct"),
+            ("ann/staleness_rows", "dcr_ann_staleness_rows"),
+            ("ingest/oldest_unfolded_age_s",
+             "dcr_ingest_oldest_unfolded_age_s"),
+            ("store/growth_rows_per_s", "dcr_store_growth_rows_per_s")):
+        assert tracing.sanitize_metric_name(raw) == want
+
+
+# ---------------------------------------------------------------------------
+# 7. slow: the acceptance e2e round trips
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_slo_fleet_availability_breach_and_recover_e2e(tmp_path, cpu_devices):
+    """A real 2-worker fleet with an injected worker_crash: GET /slo walks
+    availability ok -> breach (dcr-status exits 1) -> ok (exits 0) with
+    zero dropped requests and slo/breach + slo/recover in the fleet trace."""
+    import signal
+    import subprocess
+    import sys
+    from concurrent.futures import ThreadPoolExecutor
+
+    from dcr_tpu.core.coordination import EXIT_PREEMPTED
+    from dcr_tpu.serve.fleet import RequestJournal
+    from tests._multiproc import free_port
+    from tests.test_serve import (_export_tiny_ckpt, _get, _post_generate,
+                                  _serve_env)
+
+    ckpt = _export_tiny_ckpt(tmp_path)
+    env, repo = _serve_env()
+    env["DCR_FAULTS"] = "worker_crash@batch=0&rank=0"
+    fleet_dir = tmp_path / "fleet_slo"
+    port = free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dcr_tpu.cli.serve",
+         f"--model_path={ckpt}", f"--port={port}",
+         "--resolution=16", "--num_inference_steps=2", "--sampler=ddim",
+         "--max_batch=2", "--max_wait_ms=60", "--queue_depth=64",
+         "--request_timeout_s=300", "--seed=0",
+         "--fleet.workers=2", f"--fleet.dir={fleet_dir}",
+         "--fleet.heartbeat_s=0.5", "--fleet.lease_s=3",
+         "--fleet.dispatch_timeout_s=240", "--fleet.spawn_timeout_s=240",
+         "--fleet.max_attempts=6", "--fleet.respawn_max=6",
+         "--fleet.respawn_base_delay_s=2",
+         # tight windows so the outage (respawn + warm start, tens of
+         # seconds) breaches quickly and recovery is observable in-test
+         "--slo.short_window_s=3", "--slo.long_window_s=6",
+         "--slo.budget=0.5", "--slo.availability_min=0.9"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+
+    def fail(msg):
+        out = proc.stdout.read() if proc.stdout else ""
+        raise AssertionError(f"{msg}: {out[-4000:]}")
+
+    def wait_slo(pred, deadline_s, what):
+        deadline = time.monotonic() + deadline_s
+        last = None
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                fail(f"fleet died waiting for {what} (rc={proc.poll()})")
+            try:
+                _, doc = _get(port, "/slo", timeout=2)
+                last = doc
+                if pred(doc):
+                    return doc
+            except OSError:
+                pass
+            time.sleep(0.25)
+        raise AssertionError(f"timeout waiting for {what}; last /slo={last}")
+
+    try:
+        deadline = time.monotonic() + 300
+        while True:
+            try:
+                _, health = _get(port, "/healthz", timeout=2)
+                _, status = _get(port, "/metrics", timeout=2)
+                if (health["status"] == "ok"
+                        and status["workers_alive"] == 2):
+                    break
+            except OSError:
+                pass
+            if proc.poll() is not None or time.monotonic() > deadline:
+                fail(f"fleet did not come up (rc={proc.poll()})")
+            time.sleep(0.5)
+
+        _, doc = _get(port, "/slo", timeout=5)
+        assert doc["enabled"] is True
+        assert "availability" in doc["objectives"]
+
+        # the crash fires on worker 0's first batch
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            futures = [ex.submit(_post_generate, port, p, seed=i,
+                                 timeout=280)
+                       for i, p in enumerate(["a red square",
+                                              "a blue circle"] * 2)]
+
+            doc = wait_slo(
+                lambda d: d["objectives"]["availability"]["state"]
+                == "breach", 240, "availability breach")
+            assert doc["state"] == "breach"
+            assert doc["objectives"]["availability"]["value"] is not None
+            assert doc["objectives"]["availability"]["value"] < 0.9
+            # dcr-status sees the same thing and exits 1
+            sdoc = cli_status.collect("127.0.0.1", port, 5.0)
+            assert cli_status.exit_code(sdoc) == 1
+            assert "BREACH" in cli_status.render_human(sdoc)
+            # the state gauge rides the merged Prometheus exposition
+            prom = cli_status.get_text(
+                "127.0.0.1", port, "/metrics?format=prometheus", 5.0)
+            assert "dcr_slo_state_availability" in prom
+
+            # every accepted request still completes (requeued onto the
+            # survivor) while the objective is breached
+            for f in futures:
+                code, body = f.result(timeout=280)
+                assert code == 200, (code, body)
+
+        doc = wait_slo(
+            lambda d: d["objectives"]["availability"]["state"] == "ok"
+            and d["objectives"]["availability"]["breach_total"] >= 1,
+            420, "availability recovery")
+        assert doc["breach_total"] >= 1
+        sdoc = cli_status.collect("127.0.0.1", port, 5.0)
+        assert cli_status.exit_code(sdoc) == 0
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        assert rc == EXIT_PREEMPTED, rc
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    counts = RequestJournal.replay(fleet_dir / "journal.jsonl")["counts"]
+    assert counts["accepted"] == 4 and counts["acked"] == 4
+    assert counts["dropped"] == 0 and counts["failed"] == 0
+
+    breach = recover = False
+    for trace in fleet_dir.rglob("trace*.jsonl*"):
+        text = trace.read_text(errors="replace")
+        breach = breach or '"slo/breach"' in text
+        recover = recover or '"slo/recover"' in text
+    assert breach, "no slo/breach event in the fleet trace"
+    assert recover, "no slo/recover event in the fleet trace"
+
+
+@pytest.mark.slow
+def test_slo_ingest_stall_breach_and_recover_integration(
+        tmp_path, rng_np, monkeypatch):
+    """The ingest_stall drill through the REAL signal chain: a stalled
+    IngestPump's lag gauges ride the worker exposition into the
+    supervisor's signal snapshot and walk the ingest_lag_s objective
+    ok -> breach -> ok (lag ~0 after compaction), with zero rows lost."""
+    from dcr_tpu.serve.ingest import IngestPump
+    from dcr_tpu.serve.supervisor import ALIVE, FleetSupervisor
+
+    monkeypatch.setenv("DCR_INGEST_STALL_S", "3")
+    cfg = ServeConfig(
+        resolution=16, num_inference_steps=2, sampler="ddim",
+        fleet=FleetConfig(workers=1, dir=str(tmp_path / "fleet")),
+        ingest=IngestConfig(enabled=True),
+        risk=RiskConfig(store_dir=str(tmp_path / "store")),
+        slo=SloConfig(short_window_s=0.8, long_window_s=1.6, budget=0.5,
+                      ingest_lag_s_max=0.5, dump_after_s=-1.0))
+    sup = FleetSupervisor(cfg)                # never started: we tick it
+    sup._slots[0].state = ALIVE
+    assert {o.name for o in sup._slo.objectives()} >= {"availability",
+                                                       "ingest_lag_s"}
+
+    def tick():
+        # what the scrape loop would have cached: this process's own
+        # registry, where the pump's gauges live
+        sup._scrape._cache = {0: (tracing.registry().prometheus_text(),
+                                  time.time())}
+        sup._slo.observe(sup._slo_signals())
+        return sup.slo_doc()["objectives"]["ingest_lag_s"]
+
+    def tick_until(pred, deadline_s, what):
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            obj = tick()
+            if pred(obj):
+                return obj
+            time.sleep(0.1)
+        raise AssertionError(f"timeout waiting for {what}: {tick()}")
+
+    row = rng_np.standard_normal(DIM).astype(np.float32)
+    try:
+        faults.install("ingest_stall@row=0")
+        with IngestPump(tmp_path / "store", embed_dim=DIM, queue_max=16,
+                        batch_rows=1, compact_rows=1) as pump:
+            assert tick()["state"] == "ok"
+            assert pump.offer(row, "k0") is True
+            # the stall holds the ack for 3s; lag climbs past the 0.5s
+            # target and both sub-second windows saturate
+            breached = tick_until(lambda o: o["state"] == "breach", 30,
+                                  "ingest_lag_s breach")
+            assert breached["value"] > 0.5
+            status_doc = {"reachable": True, "health": {"status": "ok"},
+                          "slo": sup.slo_doc()}
+            assert cli_status.exit_code(status_doc) == 1
+            # stall ends -> append -> compact_rows=1 folds the WAL: lag
+            # and backlog return to ~0 and the objective recovers
+            recovered = tick_until(
+                lambda o: o["state"] == "ok" and o["breach_total"] >= 1,
+                30, "ingest_lag_s recovery")
+            assert recovered["breach_total"] >= 1
+            stats = pump.stats()
+            assert stats["appended_rows"] == 1 and stats["dropped_rows"] == 0
+            assert stats["compactions"] >= 1
+        assert _gauge("ingest/backlog_rows") == 0
+        assert _gauge("ingest/oldest_unfolded_age_s") == 0.0
+        status_doc = {"reachable": True, "health": {"status": "ok"},
+                      "slo": sup.slo_doc()}
+        assert cli_status.exit_code(status_doc) == 0
+    finally:
+        faults.clear()
+        sup.journal.close()

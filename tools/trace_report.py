@@ -520,12 +520,36 @@ def ann_summary(records: list[dict]) -> dict | None:
             "total_ms": round(sum(r["dur"] for r in kmeans) / 1e3, 3),
         }
     if recalls:
+        # sample-count-weighted (dcr-slo): a 256-query check must outweigh
+        # a 4-query one — an unweighted mean of check means is not a recall
         vals = sorted(float(e["args"].get("recall", 0.0)) for e in recalls)
+        weighted = sum(float(e["args"].get("recall", 0.0))
+                       * max(1, int(e["args"].get("queries", 1)))
+                       for e in recalls)
+        samples = sum(max(1, int(e["args"].get("queries", 1)))
+                      for e in recalls)
         out["recall_spot_checks"] = {
             "checks": len(recalls),
             "k": int(recalls[-1]["args"].get("k", 0)),
+            "samples": samples,
             "min_recall": round(vals[0], 4),
-            "mean_recall": round(sum(vals) / len(vals), 4),
+            "mean_recall": round(weighted / samples, 4),
+        }
+    probes = [r for r in records
+              if r["ph"] == "i" and r["name"] == "ann/recall_probe"]
+    if probes:
+        weighted = sum(float(e["args"].get("recall", 0.0))
+                       * max(1, int(e["args"].get("queries", 1)))
+                       for e in probes)
+        samples = sum(max(1, int(e["args"].get("queries", 1)))
+                      for e in probes)
+        last = probes[-1]["args"]
+        out["recall_online"] = {
+            "probes": len(probes),
+            "k": int(last.get("k", 0)),
+            "samples": samples,
+            "mean_recall": round(weighted / samples, 4),
+            "last_rolling": round(float(last.get("rolling", 0.0)), 4),
         }
     return out
 
@@ -583,6 +607,49 @@ def ingest_summary(records: list[dict]) -> dict | None:
              "ms": round(r["dur"] / 1e3, 3)}
             for r in sorted(recovers, key=lambda r: r["ts"])][:50]
     return out
+
+
+def slo_summary(records: list[dict]) -> dict | None:
+    """The "SLO" section (dcr-slo): breach/recover timeline per objective.
+
+    Built from the ``slo/breach`` and ``slo/recover`` instant events the
+    supervisor-side engine emits on every state transition. Each breach is
+    paired with the next recover of the same objective so the rendered
+    timeline shows breach duration; an unrecovered breach is marked open.
+    None when no SLO events — other traces keep their shape.
+    """
+    transitions = sorted((r for r in records if r["ph"] == "i"
+                          and r["name"] in ("slo/breach", "slo/recover")),
+                         key=lambda r: r["ts"])
+    if not transitions:
+        return None
+    objectives: dict[str, dict] = {}
+    timeline = []
+    open_breach: dict[str, dict] = {}
+    for r in transitions:
+        obj = str(r["args"].get("objective", "?"))
+        st = objectives.setdefault(obj, {"breaches": 0, "recoveries": 0})
+        entry = {
+            "time": _fmt_ts(r["ts"]), "ts": r["ts"],
+            "event": r["name"].split("/", 1)[1],
+            "objective": obj,
+            "value": r["args"].get("value"),
+            "target": r["args"].get("target"),
+        }
+        if r["name"] == "slo/breach":
+            st["breaches"] += 1
+            entry["burn"] = r["args"].get("burn_short")
+            open_breach[obj] = entry
+        else:
+            st["recoveries"] += 1
+            entry["breach_s"] = r["args"].get("breach_s")
+            open_breach.pop(obj, None)
+        timeline.append(entry)
+    return {
+        "objectives": dict(sorted(objectives.items())),
+        "open_breaches": sorted(open_breach),
+        "timeline": timeline[:100],
+    }
 
 
 def _interval_overlap_us(a: list[tuple[float, float]],
@@ -853,6 +920,7 @@ def summarize(records: list[dict], meta: dict | None = None) -> dict:
         "pipeline": pipeline_summary(records),
         "memory": memory_summary(records),
         "fault_timeline": faults,
+        "slo": slo_summary(records),
         "fleet": fleet_summary(records, meta or {}),
     }
 
@@ -1038,8 +1106,16 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         if rc:
             lines.append(
                 f"  recall spot-check: {rc['checks']} check(s) at "
-                f"k={rc['k']} — mean {rc['mean_recall']}, "
+                f"k={rc['k']} over {rc['samples']} query(ies) — "
+                f"sample-weighted mean {rc['mean_recall']}, "
                 f"min {rc['min_recall']}")
+        ro = annsec.get("recall_online")
+        if ro:
+            lines.append(
+                f"  online recall (shadow-oracle probes): {ro['probes']} "
+                f"probe(s) at k={ro['k']} over {ro['samples']} query(ies) — "
+                f"sample-weighted mean {ro['mean_recall']}, "
+                f"last rolling {ro['last_rolling']}")
     ing = summary.get("ingest")
     if ing:
         lines.append("\ningest:")
@@ -1070,6 +1146,23 @@ def render_text(summary: dict, paths: list[Path] | Path) -> str:
         for f in risk["flagged_timeline"][:10]:
             lines.append(f"  {f['time']} FLAGGED req {f['request_id']} "
                          f"sim {f['max_sim']} -> {f['top_key']}")
+    slo = summary.get("slo")
+    if slo:
+        counts = ", ".join(
+            f"{name}: {st['breaches']} breach(es)/{st['recoveries']} "
+            f"recovery(ies)" for name, st in slo["objectives"].items())
+        lines.append(f"\nSLO: {counts}")
+        if slo["open_breaches"]:
+            lines.append(
+                "  still in breach at end of trace: "
+                + ", ".join(slo["open_breaches"]))
+        for t in slo["timeline"]:
+            mark = "BREACH " if t["event"] == "breach" else "recover"
+            extra = (f"burn {t.get('burn')}" if t["event"] == "breach"
+                     else f"after {t.get('breach_s')}s in breach")
+            lines.append(
+                f"  {t['time']} {mark} {t['objective']:<20} "
+                f"value={t.get('value')} target={t.get('target')}  {extra}")
     if summary["fault_timeline"]:
         lines.append("\nfault timeline:")
         for f in summary["fault_timeline"]:
